@@ -1,0 +1,84 @@
+"""cfg parser tests against the actual reference Raft.cfg grammar."""
+
+import textwrap
+
+import pytest
+
+from tla_raft_tpu.cfgparse import parse_cfg, to_raft_config
+
+REFERENCE_CFG = textwrap.dedent(
+    r"""
+    CONSTANTS
+        MaxTerm = 3
+        MaxRestart = 3
+        MaxElection = 3
+        Follower = Follower
+        Candidate = Candidate
+        Leader = Leader
+        None = None
+        VoteReq = VoteReq
+        VoteResp = VoteResp
+        AppendReq = AppendReq
+        AppendResp = AppendResp
+        s1 = s1
+        s2 = s2
+        s3 = s3
+        s4 = s4
+        s5 = s5
+        Servers = {s1, s2, s3}
+        v1 = v1
+        v2 = v2
+        Vals = {v1, v2}
+
+    \* SYMMETRY Permutations(Servers)
+    SYMMETRY symmServers
+
+    VIEW view
+
+    \* SYMMETRY symmValues
+
+    INIT Init
+    NEXT Next
+
+    INVARIANT
+    Inv
+    """
+)
+
+
+def test_parse_reference_cfg():
+    cfg = parse_cfg(REFERENCE_CFG)
+    assert cfg.constants["Servers"] == frozenset({"s1", "s2", "s3"})
+    assert cfg.constants["Vals"] == frozenset({"v1", "v2"})
+    assert cfg.constants["MaxElection"] == 3
+    assert cfg.constants["MaxRestart"] == 3
+    assert cfg.constants["MaxTerm"] == 3  # vestigial, recorded only
+    assert cfg.constants["s4"] == "s4"  # declared but unused
+    assert cfg.symmetry == "symmServers"  # commented variants ignored
+    assert cfg.view == "view"
+    assert cfg.init == "Init"
+    assert cfg.next == "Next"
+    assert cfg.invariants == ("Inv",)
+
+
+def test_lower_to_raft_config():
+    rc = to_raft_config(parse_cfg(REFERENCE_CFG))
+    assert rc.n_servers == 3
+    assert rc.n_vals == 2
+    assert rc.max_election == 3
+    assert rc.max_restart == 3
+    assert rc.symmetry and rc.use_view
+    assert rc.invariants == ("Inv",)
+    assert rc.max_term_cfg == 3
+    assert rc.T == 3 and rc.L == 3 and rc.majority == 2
+
+
+def test_symmetry_override():
+    rc = to_raft_config(parse_cfg(REFERENCE_CFG), symmetry_override=False)
+    assert not rc.symmetry
+
+
+def test_bad_init_rejected():
+    bad = REFERENCE_CFG.replace("INIT Init", "INIT Start")
+    with pytest.raises(ValueError):
+        to_raft_config(parse_cfg(bad))

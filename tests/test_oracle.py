@@ -1,0 +1,96 @@
+"""Oracle self-tests: golden distinct-state counts + probe/mutation behavior.
+
+Golden counts were produced by the oracle itself on first bring-up and are
+pinned here to catch semantic regressions; the JAX checker is separately
+required to match the oracle exactly (test_parity.py), so any unnoticed
+oracle bug would have to be reproduced independently by the tensor kernels
+to slip through.
+"""
+
+import pytest
+
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.oracle import OracleChecker
+from tla_raft_tpu.oracle.explicit import init_state, successors
+
+
+GOLDEN = [
+    # (cfg kwargs, symmetry, distinct, generated, depth)
+    (dict(n_servers=2, n_vals=1, max_election=1, max_restart=1), False, 99, 192, 12),
+    (dict(n_servers=2, n_vals=1, max_election=1, max_restart=1), True, 50, 97, 12),
+    (dict(n_servers=2, n_vals=1, max_election=2, max_restart=1), False, 1726, 3280, 21),
+    (dict(n_servers=2, n_vals=1, max_election=2, max_restart=1), True, 864, 1641, 21),
+    (dict(n_servers=3, n_vals=1, max_election=1, max_restart=0), False, 1600, 5919, 18),
+    (dict(n_servers=3, n_vals=1, max_election=1, max_restart=0), True, 276, 1015, 18),
+]
+
+
+@pytest.mark.parametrize("kw,sym,distinct,generated,depth", GOLDEN)
+def test_golden_counts(kw, sym, distinct, generated, depth):
+    cfg = RaftConfig(symmetry=sym, **kw)
+    r = OracleChecker(cfg).run()
+    assert r.ok
+    assert r.distinct == distinct
+    assert r.generated == generated
+    assert r.depth == depth
+
+
+def test_init_matches_spec():
+    cfg = RaftConfig(n_servers=3, n_vals=2)
+    st = init_state(cfg)
+    assert st.voted_for == (0, 0, 0)
+    assert st.current_term == (0, 0, 0)
+    assert st.logs == (((0, 0),),) * 3  # sentinel, Raft.tla:97
+    assert st.match_index == ((1, 1, 1),) * 3
+    assert st.next_index == ((2, 2, 2),) * 3
+    assert st.commit_index == (1, 1, 1)
+    assert st.msgs == frozenset()
+    assert st.val_sent == (0, 0)
+
+
+def test_init_has_only_become_candidate():
+    cfg = RaftConfig(n_servers=3, n_vals=2)
+    succs = successors(cfg, init_state(cfg))
+    assert len(succs) == 3
+    assert {a for a, _, _, _ in succs} == {"BecomeCandidate"}
+
+
+def test_probe_raft_can_commit_is_reachable():
+    # Running the probe's negation as the invariant must find a violation —
+    # the model can commit (SURVEY.md §4.3 reachability-probe workflow).
+    cfg = RaftConfig(
+        n_servers=3, n_vals=1, max_election=1, max_restart=0,
+        invariants=("~RaftCanCommt",),
+    )
+    r = OracleChecker(cfg).run()
+    assert not r.ok
+    kind, trace = r.violation
+    assert "RaftCanCommt" in kind
+    # The trace must start at Init and end in a committed state.
+    assert trace[0][0] == "Init"
+    assert any(ci > 1 for ci in trace[-1][1].commit_index)
+
+
+def test_probe_exist_leader_and_candidate():
+    cfg = RaftConfig(
+        n_servers=3, n_vals=1, max_election=2, max_restart=0,
+        invariants=("~ExistLeaderAndCandidate",),
+    )
+    r = OracleChecker(cfg).run()
+    assert not r.ok
+
+
+def test_no_split_vote_holds():
+    cfg = RaftConfig(
+        n_servers=3, n_vals=1, max_election=1, max_restart=0,
+        invariants=("Inv", "NoSplitVote"),
+    )
+    assert OracleChecker(cfg).run().ok
+
+
+def test_symmetry_reduction_factor_bounded():
+    kw = dict(n_servers=3, n_vals=1, max_election=1, max_restart=0)
+    full = OracleChecker(RaftConfig(symmetry=False, **kw)).run()
+    sym = OracleChecker(RaftConfig(symmetry=True, **kw)).run()
+    assert sym.distinct <= full.distinct
+    assert full.distinct <= 6 * sym.distinct  # at most |Servers|! collapse

@@ -1,0 +1,22 @@
+"""tla_raft_tpu — a TPU-native model-checking framework.
+
+Re-implements the capability of the reference (kikimo/tla-raft: a TLA+ Raft
+specification checked by the Java TLC model checker, see
+/root/reference/Raft.tla, /root/reference/Raft.cfg, /root/reference/myrun.sh)
+as data-parallel JAX/XLA kernels:
+
+- the Raft state vector is encoded as fixed-width integer tensors
+  (models/raft.py),
+- the ``Next``-action disjunction (Raft.tla:416-430) compiles to a vmap'd
+  masked successor kernel with a statically-bounded fan-out,
+- TLC's fingerprint set (FPSet) and worker pool become a sorted on-device
+  fingerprint store + per-core frontier shards deduplicated with ICI
+  collectives each BFS level (parallel/),
+- symmetry reduction (Raft.cfg:24) and the VIEW projection (Raft.cfg:26)
+  are permutation gather tables + a slot-level 64-bit hash (ops/hashing.py),
+- a pure-Python explicit-state checker (oracle/) reproduces TLC's semantics
+  exactly and serves as the differential-testing oracle, since the reference
+  publishes no numbers and TLC itself (a Java tool) is not vendored.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from .explicit import OracleChecker, OState, init_state, successors  # noqa: F401

@@ -1,0 +1,760 @@
+"""Pure-Python explicit-state reference checker (the differential oracle).
+
+This is a direct, unoptimized interpretation of the reference spec
+(/root/reference/Raft.tla) under the reference checker semantics selected by
+/root/reference/Raft.cfg and /root/reference/myrun.sh:
+
+  * breadth-first exploration from ``Init`` (Raft.tla:93-105) over the 11
+    live disjuncts of ``Next`` (Raft.tla:416-430),
+  * deduplication on the ``VIEW view`` projection (Raft.cfg:26,
+    Raft.tla:38) — the 8 "real" variables, aux vars excluded — with the
+    *first representative reached* supplying the full state for expansion,
+  * ``SYMMETRY symmServers`` (Raft.cfg:24, Raft.tla:21): states equal up to
+    a permutation of Servers are identified,
+  * ``INVARIANT Inv`` (Raft.cfg:33-34 → Raft.tla:502) checked on every
+    distinct state, plus the in-path ``Assert(role[s] # Leader, "split
+    brain")`` (Raft.tla:185) evaluated during successor generation,
+  * deadlock NOT reported (``-deadlock``, myrun.sh:3).
+
+It exists because the reference's checker (TLC, a Java tool) is external and
+not vendored; every tensor kernel in the JAX path is differentially tested
+against this module on small configurations (SURVEY.md §4).
+
+Encoding conventions (shared with models/raft.py):
+  servers are 1..S; ``votedFor`` uses 0 for None (Raft.tla:10);
+  roles are 0=Follower, 1=Candidate, 2=Leader;
+  logs are tuples of (term, val) pairs with the sentinel (0, 0) at python
+  index 0 = TLA index 1 (Raft.tla:97); vals are 1..V with 0 = None;
+  ``valSent`` is 0=None, 1=FALSE (TRUE is never assigned — Raft.tla:237).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterable, NamedTuple
+
+from ..config import (
+    APPEND_REQ,
+    APPEND_RESP,
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    NONE,
+    VOTE_REQ,
+    VOTE_RESP,
+    RaftConfig,
+)
+
+
+class OState(NamedTuple):
+    """Full checker state — 12 variables (Raft.tla:26,29,34)."""
+
+    voted_for: tuple[int, ...]  # [S], 0 = None
+    current_term: tuple[int, ...]  # [S]
+    role: tuple[int, ...]  # [S]
+    logs: tuple[tuple[tuple[int, int], ...], ...]  # [S][len][(term,val)]
+    match_index: tuple[tuple[int, ...], ...]  # [S][S], TLA 1-based values
+    next_index: tuple[tuple[int, ...], ...]  # [S][S]
+    commit_index: tuple[int, ...]  # [S]
+    msgs: frozenset  # set of message tuples, see below
+    election_count: int
+    restart_count: int
+    pending_response: tuple[tuple[int, ...], ...]  # [S][S] 0/1
+    val_sent: tuple[int, ...]  # [V], 0 = None, 1 = FALSE
+
+
+# Message tuples (type tag first):
+#   (VOTE_REQ,    src, dst, term, lastLogIndex, lastLogTerm)   Raft.tla:118-125
+#   (VOTE_RESP,   src, dst, term)                              Raft.tla:149
+#   (APPEND_REQ,  src, dst, term, prevLogIndex, prevLogTerm,
+#                 entries, leaderCommit)                       Raft.tla:254-263
+#       entries: () or ((term, val),)
+#   (APPEND_RESP, src, dst, term, prevLogIndex, succ)          Raft.tla:283-290
+
+
+class SplitBrainAbort(Exception):
+    """The Assert(role[s] # Leader, "split brain") at Raft.tla:185 fired."""
+
+    def __init__(self, state: OState, server: int):
+        super().__init__(f"split brain at server {server}")
+        self.state = state
+        self.server = server
+
+
+def init_state(cfg: RaftConfig) -> OState:
+    """Init — Raft.tla:93-105. Exactly one initial state."""
+    S, V = cfg.S, cfg.V
+    return OState(
+        voted_for=(NONE,) * S,
+        current_term=(0,) * S,
+        role=(FOLLOWER,) * S,
+        logs=(((0, 0),),) * S,  # sentinel entry, Raft.tla:97
+        match_index=((1,) * S,) * S,
+        next_index=((2,) * S,) * S,
+        commit_index=(1,) * S,
+        msgs=frozenset(),
+        election_count=0,
+        restart_count=0,
+        pending_response=((0,) * S,) * S,
+        val_sent=(NONE,) * V,
+    )
+
+
+def _replace_server(tup: tuple, s: int, val) -> tuple:
+    """[f EXCEPT ![s] = val] for a per-server tuple (s is 1-based)."""
+    return tup[: s - 1] + (val,) + tup[s:]
+
+
+def _replace2(mat: tuple, s: int, t: int, val) -> tuple:
+    return _replace_server(mat, s, _replace_server(mat[s - 1], t, val))
+
+
+# ---------------------------------------------------------------------------
+# Actions. Each yields (successor, detail) for every witness; `detail`
+# records the existential witness for debugging / trace annotation.
+# ---------------------------------------------------------------------------
+
+
+def become_candidate(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
+    """BecomeCandidate(s) — Raft.tla:107-130."""
+    if st.election_count >= cfg.max_election:
+        return
+    if st.role[s - 1] not in (FOLLOWER, CANDIDATE):
+        return
+    new_term = st.current_term[s - 1] + 1
+    log = st.logs[s - 1]
+    last_log_index = len(log)  # TLA Len(logs[s])
+    last_log_term = log[-1][0]
+    vote_reqs = frozenset(
+        (VOTE_REQ, s, p, new_term, last_log_index, last_log_term)
+        for p in range(1, cfg.S + 1)
+        if p != s
+    )
+    yield (
+        st._replace(
+            election_count=st.election_count + 1,
+            current_term=_replace_server(st.current_term, s, new_term),
+            role=_replace_server(st.role, s, CANDIDATE),
+            voted_for=_replace_server(st.voted_for, s, s),
+            msgs=st.msgs | vote_reqs,
+        ),
+        (),
+    )
+
+
+def update_term(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
+    """UpdateTerm(s) — Raft.tla:175-188.
+
+    Branch (b) evaluates ``Assert(role[s] # Leader)`` (Raft.tla:185) *before*
+    the ``role[s] = Candidate`` conjunct: any AppendReq to s at s's current
+    term while s is Leader aborts the whole run.
+    """
+    cur = st.current_term[s - 1]
+    for m in st.msgs:
+        if m[2] != s:  # m.dst = s
+            continue
+        term = m[3]
+        if term > cur:
+            yield (
+                st._replace(
+                    role=_replace_server(st.role, s, FOLLOWER),
+                    current_term=_replace_server(st.current_term, s, term),
+                    voted_for=_replace_server(st.voted_for, s, NONE),
+                ),
+                (m,),
+            )
+        if term == cur and m[0] == APPEND_REQ:
+            if st.role[s - 1] == LEADER:
+                raise SplitBrainAbort(st, s)
+            if st.role[s - 1] == CANDIDATE:
+                yield (st._replace(role=_replace_server(st.role, s, FOLLOWER)), (m,))
+
+
+def response_vote(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
+    """ResponseVote(s) — Raft.tla:132-155. Grant-only, exact-term."""
+    if st.role[s - 1] != FOLLOWER:
+        return
+    cur = st.current_term[s - 1]
+    log = st.logs[s - 1]
+    my_lli = len(log)
+    my_llt = log[-1][0]
+    for m in st.msgs:
+        if m[0] != VOTE_REQ or m[2] != s or m[3] != cur:
+            continue
+        src = m[1]
+        if st.voted_for[s - 1] not in (NONE, src):
+            continue
+        m_lli, m_llt = m[4], m[5]
+        up_to_date = (m_llt > my_llt) or (m_llt == my_llt and m_lli >= my_lli)
+        if not up_to_date:
+            continue
+        grant = (VOTE_RESP, s, src, m[3])
+        if grant in st.msgs:
+            continue
+        yield (
+            st._replace(
+                msgs=st.msgs | {grant},
+                voted_for=_replace_server(st.voted_for, s, src),
+            ),
+            (m,),
+        )
+
+
+def become_leader(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
+    """BecomeLeader(s) — Raft.tla:157-173."""
+    if st.role[s - 1] != CANDIDATE:
+        return
+    cur = st.current_term[s - 1]
+    resps = sum(
+        1 for m in st.msgs if m[0] == VOTE_RESP and m[2] == s and m[3] == cur
+    )
+    if resps + 1 < cfg.majority:  # self-vote counted, Raft.tla:164
+        return
+    log_len = len(st.logs[s - 1])
+    yield (
+        st._replace(
+            role=_replace_server(st.role, s, LEADER),
+            match_index=_replace_server(
+                st.match_index,
+                s,
+                tuple(log_len if u == s else 1 for u in range(1, cfg.S + 1)),
+            ),
+            next_index=_replace_server(st.next_index, s, (log_len + 1,) * cfg.S),
+            pending_response=_replace_server(st.pending_response, s, (0,) * cfg.S),
+        ),
+        (),
+    )
+
+
+def client_req(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
+    """ClientReq(s) — Raft.tla:233-240. Each value proposed at most once."""
+    if st.role[s - 1] != LEADER:
+        return
+    cur = st.current_term[s - 1]
+    log = st.logs[s - 1]
+    for v in range(1, cfg.V + 1):
+        if st.val_sent[v - 1] != NONE:
+            continue
+        yield (
+            st._replace(
+                val_sent=_replace_server(st.val_sent, v, 1),  # := FALSE
+                logs=_replace_server(st.logs, s, log + ((cur, v),)),
+                match_index=_replace2(st.match_index, s, s, len(log) + 1),
+            ),
+            (v,),
+        )
+
+
+def leader_append_entry(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
+    """LeaderAppendEntry(s) — Raft.tla:242-269. At most ONE entry per request."""
+    if st.role[s - 1] != LEADER:
+        return
+    log = st.logs[s - 1]
+    for dst in range(1, cfg.S + 1):
+        if dst == s:
+            continue
+        ni = st.next_index[s - 1][dst - 1]
+        if ni > len(log) + 1:
+            continue
+        if st.pending_response[s - 1][dst - 1]:
+            continue
+        prev_log_index = ni - 1
+        prev_log_term = log[prev_log_index - 1][0]
+        entries = (log[ni - 1],) if ni <= len(log) else ()
+        m = (
+            APPEND_REQ,
+            s,
+            dst,
+            st.current_term[s - 1],
+            prev_log_index,
+            prev_log_term,
+            entries,
+            st.commit_index[s - 1],
+        )
+        if m in st.msgs:
+            continue
+        yield (
+            st._replace(
+                pending_response=_replace2(st.pending_response, s, dst, 1),
+                msgs=st.msgs | {m},
+            ),
+            (dst,),
+        )
+
+
+def _log_match(st: OState, s: int, pli: int, plt: int) -> bool:
+    """LogMatch(s, m) — Raft.tla:271-273."""
+    log = st.logs[s - 1]
+    return pli <= len(log) and log[pli - 1][0] == plt
+
+
+def follower_accept_entry(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
+    """FollowerAcceptEntry(s) — Raft.tla:275-300. No ``\\notin msgs`` guard."""
+    if st.role[s - 1] != FOLLOWER:
+        return
+    cur = st.current_term[s - 1]
+    log = st.logs[s - 1]
+    for m in st.msgs:
+        if m[0] != APPEND_REQ or m[2] != s or m[3] != cur:
+            continue
+        _, src, _, term, pli, plt, entries, leader_commit = m
+        if not _log_match(st, s, pli, plt):
+            continue
+        acc_resp = (APPEND_RESP, s, src, term, pli + len(entries), True)
+        new_log = log[:pli] + entries
+        append_new = len(new_log) > len(log)
+        truncated = len(new_log) <= len(log) and new_log != log[: len(new_log)]
+        new_commit = max(st.commit_index[s - 1], min(leader_commit, len(new_log)))
+        updated_log = new_log if (truncated or append_new) else log
+        yield (
+            st._replace(
+                msgs=st.msgs | {acc_resp},
+                commit_index=_replace_server(st.commit_index, s, new_commit),
+                logs=_replace_server(st.logs, s, updated_log),
+            ),
+            (m,),
+        )
+
+
+def follower_reject_entry(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
+    """FollowerRejectEntry(s) — Raft.tla:302-321. prevLogIndex UNCHANGED."""
+    if st.role[s - 1] != FOLLOWER:
+        return
+    cur = st.current_term[s - 1]
+    for m in st.msgs:
+        if m[0] != APPEND_REQ or m[2] != s or m[3] != cur:
+            continue
+        _, src, _, term, pli, plt, _entries, _lc = m
+        if _log_match(st, s, pli, plt):
+            continue
+        reject = (APPEND_RESP, s, src, term, pli, False)
+        if reject in st.msgs:
+            continue
+        yield (st._replace(msgs=st.msgs | {reject}), (m,))
+
+
+def handle_append_resp(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
+    """HandleAppendResp(s) — Raft.tla:374-396."""
+    if st.role[s - 1] != LEADER:
+        return
+    cur = st.current_term[s - 1]
+    for m in st.msgs:
+        if m[0] != APPEND_RESP or m[2] != s or m[3] != cur:
+            continue
+        _, src, _, _, pli, succ = m
+        if not st.pending_response[s - 1][src - 1]:
+            continue
+        if succ:
+            if not (st.match_index[s - 1][src - 1] < pli):  # Raft.tla:383
+                continue
+            yield (
+                st._replace(
+                    match_index=_replace2(st.match_index, s, src, pli),
+                    next_index=_replace2(st.next_index, s, src, pli + 1),
+                    pending_response=_replace2(st.pending_response, s, src, 0),
+                ),
+                (m,),
+            )
+        else:
+            if pli + 1 != st.next_index[s - 1][src - 1]:  # Raft.tla:391
+                continue
+            if not (pli > st.match_index[s - 1][src - 1]):  # Raft.tla:392
+                continue
+            yield (
+                st._replace(
+                    pending_response=_replace2(st.pending_response, s, src, 0),
+                    next_index=_replace2(st.next_index, s, src, pli),
+                ),
+                (m,),
+            )
+
+
+def _median(cfg: RaftConfig, row: tuple[int, ...]) -> int:
+    """Median(F) — Raft.tla:70-75: the MajoritySize-th smallest value."""
+    return sorted(row)[cfg.majority - 1]
+
+
+def leader_can_commit(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
+    """LeaderCanCommit(s) — Raft.tla:398-407.
+
+    Faithfully omits the "current-term-entry only" commit restriction
+    (Raft §5.4.2); the reference leaves it out (`TODO` at Raft.tla:387).
+    """
+    if st.role[s - 1] != LEADER:
+        return
+    median = _median(cfg, st.match_index[s - 1])
+    if median <= st.commit_index[s - 1]:
+        return
+    yield (st._replace(commit_index=_replace_server(st.commit_index, s, median)), ())
+
+
+def restart(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
+    """Restart(s) — Raft.tla:409-414: Leader-only step-down, nothing else lost."""
+    if st.role[s - 1] != LEADER:
+        return
+    if st.restart_count >= cfg.max_restart:
+        return
+    yield (
+        st._replace(
+            restart_count=st.restart_count + 1,
+            role=_replace_server(st.role, s, FOLLOWER),
+        ),
+        (),
+    )
+
+
+# Order matches the Next disjunction (Raft.tla:416-430).
+ACTIONS: tuple[tuple[str, Callable], ...] = (
+    ("BecomeCandidate", become_candidate),
+    ("UpdateTerm", update_term),
+    ("ResponseVote", response_vote),
+    ("BecomeLeader", become_leader),
+    ("ClientReq", client_req),
+    ("LeaderAppendEntry", leader_append_entry),
+    ("FollowerAcceptEntry", follower_accept_entry),
+    ("FollowerRejectEntry", follower_reject_entry),
+    ("HandleAppendResp", handle_append_resp),
+    ("LeaderCanCommit", leader_can_commit),
+    ("Restart", restart),
+)
+
+
+def successors(cfg: RaftConfig, st: OState) -> list[tuple[str, int, tuple, OState]]:
+    """All successors of ``Next`` (Raft.tla:416-430): action × server × witness.
+
+    Raises SplitBrainAbort if the embedded Assert fires (Raft.tla:185).
+    """
+    out = []
+    for s in range(1, cfg.S + 1):
+        for name, fn in ACTIONS:
+            for nxt, detail in fn(cfg, st, s):
+                out.append((name, s, detail, nxt))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# VIEW projection, symmetry canonicalization
+# ---------------------------------------------------------------------------
+
+
+def view_of(st: OState) -> tuple:
+    """view — Raft.tla:38: the 8 real vars, aux vars excluded."""
+    return (
+        st.voted_for,
+        st.current_term,
+        st.logs,
+        st.match_index,
+        st.next_index,
+        st.commit_index,
+        tuple(sorted(st.msgs)),
+        st.role,
+    )
+
+
+def full_key(st: OState) -> tuple:
+    """Fingerprint key without VIEW (all 12 vars) — for -noview diffing."""
+    return (
+        view_of(st),
+        st.election_count,
+        st.restart_count,
+        st.pending_response,
+        st.val_sent,
+    )
+
+
+def _permute_msg(m: tuple, p: tuple[int, ...]) -> tuple:
+    # src/dst are fields 1 and 2 in every message tuple.
+    return (m[0], p[m[1] - 1], p[m[2] - 1]) + m[3:]
+
+
+def permute_view(cfg: RaftConfig, st: OState, p: tuple[int, ...]) -> tuple:
+    """Apply server permutation p (1-based images) to the view projection.
+
+    Per-server structures move to permuted slots; server-valued scalars
+    (votedFor, msg src/dst) are remapped through p. This mirrors TLC's
+    symmetry normalization of model values under ``Permutations(Servers)``.
+    """
+    S = cfg.S
+    inv = [0] * S
+    for s in range(1, S + 1):
+        inv[p[s - 1] - 1] = s  # inv[i-1] = preimage of server i
+    def pv(x: int) -> int:  # permute a server-valued scalar (0 = None fixed)
+        return p[x - 1] if x else 0
+
+    voted_for = tuple(pv(st.voted_for[inv[i] - 1]) for i in range(S))
+    current_term = tuple(st.current_term[inv[i] - 1] for i in range(S))
+    role = tuple(st.role[inv[i] - 1] for i in range(S))
+    logs = tuple(st.logs[inv[i] - 1] for i in range(S))
+    commit = tuple(st.commit_index[inv[i] - 1] for i in range(S))
+    match_index = tuple(
+        tuple(st.match_index[inv[i] - 1][inv[j] - 1] for j in range(S)) for i in range(S)
+    )
+    next_index = tuple(
+        tuple(st.next_index[inv[i] - 1][inv[j] - 1] for j in range(S)) for i in range(S)
+    )
+    msgs = tuple(sorted(_permute_msg(m, p) for m in st.msgs))
+    return (voted_for, current_term, logs, match_index, next_index, commit, msgs, role)
+
+
+def canonical_key(cfg: RaftConfig, st: OState, perms: list[tuple[int, ...]] | None = None) -> tuple:
+    """min over Permutations(Servers) of the (possibly VIEW-projected) state."""
+    if perms is None:
+        perms = cfg.server_perms()
+    if cfg.use_view:
+        if not cfg.symmetry:
+            return view_of(st)
+        return min(permute_view(cfg, st, p) for p in perms)
+    # No VIEW: canonicalize the full state (aux vars are symmetric too:
+    # pendingResponse permutes on both axes; counters/valSent are invariant).
+    if not cfg.symmetry:
+        return full_key(st)
+    keys = []
+    for p in perms:
+        S = cfg.S
+        inv = [0] * S
+        for s in range(1, S + 1):
+            inv[p[s - 1] - 1] = s
+        pend = tuple(
+            tuple(st.pending_response[inv[i] - 1][inv[j] - 1] for j in range(S))
+            for i in range(S)
+        )
+        keys.append(
+            (
+                permute_view(cfg, st, p),
+                st.election_count,
+                st.restart_count,
+                pend,
+                st.val_sent,
+            )
+        )
+    return min(keys)
+
+
+# ---------------------------------------------------------------------------
+# Invariants (Raft.tla:432-507)
+# ---------------------------------------------------------------------------
+
+
+def raft_can_commt(cfg: RaftConfig, st: OState) -> bool:
+    """RaftCanCommt [sic] — Raft.tla:434."""
+    return any(ci > 1 for ci in st.commit_index)
+
+
+def follower_can_commit(cfg: RaftConfig, st: OState) -> bool:
+    """FollowerCanCommit — Raft.tla:436-439."""
+    return any(
+        st.role[i] == FOLLOWER and st.commit_index[i] > 1 for i in range(cfg.S)
+    )
+
+
+def commit_all(cfg: RaftConfig, st: OState) -> bool:
+    """CommitAll — Raft.tla:442 (literal constant 3)."""
+    return all(ci == 3 for ci in st.commit_index)
+
+
+def no_split_vote(cfg: RaftConfig, st: OState) -> bool:
+    """NoSplitVote — Raft.tla:444-449."""
+    leaders = [
+        (st.current_term[i])
+        for i in range(cfg.S)
+        if st.role[i] == LEADER
+    ]
+    return len(leaders) == len(set(leaders))
+
+
+def exist_leader_and_candidate(cfg: RaftConfig, st: OState) -> bool:
+    """ExistLeaderAndCandidate — Raft.tla:483-487."""
+    return any(r == LEADER for r in st.role) and any(r == CANDIDATE for r in st.role)
+
+
+def no_all_commit(cfg: RaftConfig, st: OState) -> bool:
+    """NoAllCommit — Raft.tla:451-481 (a specific 3-server scenario probe)."""
+    S = cfg.S
+    for s1 in range(1, S + 1):
+        for s2 in range(1, S + 1):
+            if s2 == s1:
+                continue
+            for s3 in range(1, S + 1):
+                if s3 == s2:  # spec only requires s1 # s2 /\ s2 # s3
+                    continue
+                if not (
+                    st.role[s1 - 1] == LEADER
+                    and st.role[s2 - 1] == FOLLOWER
+                    and st.role[s3 - 1] == FOLLOWER
+                    and st.current_term[s1 - 1] == st.current_term[s3 - 1]
+                    and st.commit_index[s1 - 1] == 2
+                    and st.commit_index[s2 - 1] == 2
+                    and st.commit_index[s3 - 1] == 1
+                    and st.match_index[s1 - 1][s2 - 1] == 2
+                    and st.match_index[s1 - 1][s3 - 1] == 2
+                ):
+                    continue
+                t3 = st.current_term[s3 - 1]
+                m1 = any(
+                    m[0] == APPEND_REQ
+                    and m[2] == s3
+                    and m[1] == s1
+                    and m[3] == t3
+                    and m[4] == 1
+                    for m in st.msgs
+                )
+                m2 = any(
+                    m[0] == APPEND_RESP
+                    and m[2] == s1
+                    and m[1] == s3
+                    and m[3] == t3
+                    and m[4] == 1
+                    and m[5] is True
+                    for m in st.msgs
+                )
+                m3 = any(
+                    m[0] == APPEND_REQ and m[2] == s3 and m[1] == s1 and m[4] == 2
+                    for m in st.msgs
+                )
+                if m1 and m2 and m3:
+                    return True
+    return False
+
+
+def leader_has_all_committed_entries(cfg: RaftConfig, st: OState) -> bool:
+    """LeaderHasAllCommittedEntries — Raft.tla:491-499.
+
+    Note the spec's ∃-quantifier: if ANY leader satisfies the property the
+    invariant holds (not ∀ leaders). Reproduced exactly.
+    """
+    leaders = [l for l in range(1, cfg.S + 1) if st.role[l - 1] == LEADER]
+    if not leaders:
+        return True
+    for l in leaders:
+        llog = st.logs[l - 1]
+        lterm = st.current_term[l - 1]
+        bad = False
+        for p in range(1, cfg.S + 1):
+            if p == l or st.current_term[p - 1] > lterm:
+                continue
+            cip = st.commit_index[p - 1]
+            if cip > len(llog):
+                bad = True
+                break
+            if any(st.logs[p - 1][i] != llog[i] for i in range(cip)):
+                bad = True
+                break
+        if not bad:
+            return True
+    return False
+
+
+INVARIANTS: dict[str, Callable[[RaftConfig, OState], bool]] = {
+    "Inv": leader_has_all_committed_entries,
+    "LeaderHasAllCommittedEntries": leader_has_all_committed_entries,
+    "RaftCanCommt": raft_can_commt,
+    "FollowerCanCommit": follower_can_commit,
+    "CommitAll": commit_all,
+    "NoSplitVote": no_split_vote,
+    "NoAllCommit": no_all_commit,
+    "ExistLeaderAndCandidate": exist_leader_and_candidate,
+}
+
+
+def resolve_invariant(name: str) -> Callable[[RaftConfig, OState], bool]:
+    """Resolve an invariant name; a leading ``~`` negates (our extension for
+    running the reference's reachability probes, SURVEY.md §4.3)."""
+    if name.startswith("~"):
+        inner = INVARIANTS[name[1:]]
+        return lambda cfg, st: not inner(cfg, st)
+    return INVARIANTS[name]
+
+
+# ---------------------------------------------------------------------------
+# BFS driver
+# ---------------------------------------------------------------------------
+
+
+class CheckResult(NamedTuple):
+    ok: bool
+    distinct: int
+    generated: int
+    depth: int  # max BFS level reached (init = level 0)
+    level_sizes: tuple[int, ...]
+    violation: tuple | None  # (kind, trace) where trace = [(action, state), ...]
+
+
+class OracleChecker:
+    """Level-synchronous BFS with view+symmetry dedup — mirrors TLC."""
+
+    def __init__(self, cfg: RaftConfig):
+        self.cfg = cfg
+        self.perms = cfg.server_perms()
+        self.inv_fns = [(n, resolve_invariant(n)) for n in cfg.invariants]
+
+    def run(self, max_depth: int | None = None) -> CheckResult:
+        cfg = self.cfg
+        init = init_state(cfg)
+        seen: dict = {}
+        states: list[OState] = []
+        parents: list[tuple[int, str]] = []  # (parent_id, action) per state id
+        level_sizes = []
+        generated = 0
+
+        def intern(st: OState, parent: int, action: str) -> int | None:
+            key = canonical_key(cfg, st, self.perms)
+            if key in seen:
+                return None
+            sid = len(states)
+            seen[key] = sid
+            states.append(st)
+            parents.append((parent, action))
+            return sid
+
+        def violation(kind: str, sid: int) -> CheckResult:
+            trace = self._trace(states, parents, sid)
+            return CheckResult(
+                False, len(states), generated, len(level_sizes) - 1,
+                tuple(level_sizes), (kind, trace),
+            )
+
+        sid0 = intern(init, -1, "Init")
+        for name, fn in self.inv_fns:
+            if not fn(cfg, init):
+                level_sizes.append(1)
+                return violation(f"Invariant {name} is violated", sid0)
+        frontier = [sid0]
+        level_sizes.append(1)
+        depth = 0
+        while frontier:
+            if max_depth is not None and depth >= max_depth:
+                break
+            next_frontier = []
+            for sid in frontier:
+                st = states[sid]
+                try:
+                    succs = successors(cfg, st)
+                except SplitBrainAbort:
+                    return violation('Assert "split brain" (Raft.tla:185)', sid)
+                generated += len(succs)
+                for action, s, _detail, nxt in succs:
+                    nid = intern(nxt, sid, f"{action}({s})")
+                    if nid is None:
+                        continue
+                    for name, fn in self.inv_fns:
+                        if not fn(cfg, nxt):
+                            level_sizes.append(len(next_frontier) + 1)
+                            return violation(f"Invariant {name} is violated", nid)
+                    next_frontier.append(nid)
+            frontier = next_frontier
+            if frontier:
+                level_sizes.append(len(frontier))
+                depth += 1
+        return CheckResult(
+            True, len(states), generated, depth, tuple(level_sizes), None
+        )
+
+    @staticmethod
+    def _trace(states, parents, sid) -> list[tuple[str, OState]]:
+        out = []
+        while sid != -1:
+            parent, action = parents[sid]
+            out.append((action, states[sid]))
+            sid = parent
+        out.reverse()
+        return out
