@@ -1,0 +1,125 @@
+"""Message-universe and state-encoding tests: bijections and roundtrips."""
+
+import numpy as np
+import pytest
+
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.models.raft import from_oracle, init_batch, to_oracle
+from tla_raft_tpu.ops.msg_universe import get_universe
+from tla_raft_tpu.oracle import OracleChecker
+from tla_raft_tpu.oracle.explicit import init_state, successors
+
+CFG = RaftConfig(n_servers=3, n_vals=2, max_election=3, max_restart=3)
+SMALL = RaftConfig(n_servers=3, n_vals=1, max_election=1, max_restart=0)
+
+
+def test_universe_size_base_config():
+    uni = get_universe(CFG)
+    # S=3,V=2,T=3,L=3: VQ 6*3*3*3=162, VP 18, AQ 6*3*3*4*7*3=4536, AP 108.
+    assert uni.vq_size == 162
+    assert uni.vp_size == 18
+    assert uni.aq_size == 4536
+    assert uni.ap_size == 108
+    assert uni.M == 4824
+    assert uni.n_words == 151
+
+
+def test_id_decode_encode_bijection():
+    uni = get_universe(CFG)
+    for i in range(uni.M):
+        m = uni.id_to_msg(i)
+        assert uni.msg_to_id(m) == i
+
+
+def test_reachable_msgs_roundtrip():
+    # Every message produced by a real run must encode/decode exactly.
+    cfg = SMALL
+    uni = get_universe(cfg)
+    seen = set()
+    frontier = [init_state(cfg)]
+    for _ in range(8):
+        nxt = []
+        for st in frontier:
+            for _, _, _, s2 in successors(cfg, st):
+                if s2 not in seen:
+                    seen.add(s2)
+                    nxt.append(s2)
+        frontier = nxt
+    msgs = set()
+    for st in seen:
+        msgs |= st.msgs
+    assert msgs
+    for m in msgs:
+        assert uni.id_to_msg(uni.msg_to_id(m)) == m
+    mask = uni.msgs_to_mask(msgs)
+    assert uni.mask_to_msgs(mask) == frozenset(msgs)
+
+
+def test_pack_unpack_bits():
+    uni = get_universe(SMALL)
+    rng = np.random.default_rng(0)
+    bits = rng.integers(0, 2, size=(5, uni.M), dtype=np.uint8)
+    assert np.array_equal(uni.unpack_bits(uni.pack_bits(bits)), bits)
+
+
+def test_state_roundtrip_via_oracle():
+    cfg = SMALL
+    # Collect a few levels of real reachable states.
+    states = [init_state(cfg)]
+    frontier = list(states)
+    seen = set(states)
+    for _ in range(6):
+        nxt = []
+        for st in frontier:
+            for _, _, _, s2 in successors(cfg, st):
+                if s2 not in seen:
+                    seen.add(s2)
+                    nxt.append(s2)
+        frontier = nxt
+        states.extend(nxt)
+    batch = from_oracle(cfg, states)
+    back = to_oracle(cfg, batch)
+    assert back == states
+
+
+def test_init_batch_matches_oracle_init():
+    cfg = CFG
+    [st] = to_oracle(cfg, init_batch(cfg, 1))
+    assert st == init_state(cfg)
+
+
+def test_perm_table_bijection_and_identity():
+    uni = get_universe(CFG)
+    pt = uni.perm_table
+    assert pt.shape[0] == 6
+    perms = CFG.server_perms()
+    ident = perms.index((1, 2, 3))
+    assert np.array_equal(pt[ident], np.arange(uni.M))
+    for p in range(pt.shape[0]):
+        assert np.array_equal(np.sort(pt[p]), np.arange(uni.M))
+
+
+def test_perm_table_matches_oracle_permute():
+    from tla_raft_tpu.oracle.explicit import _permute_msg
+
+    uni = get_universe(CFG)
+    perms = CFG.server_perms()
+    rng = np.random.default_rng(1)
+    for i in rng.integers(0, uni.M, size=200):
+        m = uni.id_to_msg(int(i))
+        for pi, p in enumerate(perms):
+            assert uni.perm_table[pi, i] == uni.msg_to_id(_permute_msg(m, p))
+
+
+def test_dst_term_masks():
+    uni = get_universe(CFG)
+    any_m = uni.dst_term_any_mask
+    aq_m = uni.dst_term_appendreq_mask
+    for s in (1, 2, 3):
+        for t in (1, 2, 3):
+            bits = uni.unpack_bits(any_m[s - 1, t - 1])
+            expect = (uni.dst == s) & (uni.term == t)
+            assert np.array_equal(bits.astype(bool), expect)
+            bits = uni.unpack_bits(aq_m[s - 1, t - 1]).astype(bool)
+            expect = expect & (uni.typ == 2)
+            assert np.array_equal(bits, expect)

@@ -1,0 +1,157 @@
+"""Tensor encoding of the Raft checker state (SURVEY.md §7.1).
+
+The 12 spec variables (Raft.tla:26,29,34) become a struct-of-arrays pytree
+with one leading batch dimension and fully static shapes derived from the
+model constants. All per-server data is uint8 (domains are tiny: terms <=
+MaxElection, indexes <= L+1); the message set is a packed uint32 bitmask
+over the enumerated message universe (ops/msg_universe.py).
+
+Canonical-form invariants maintained by every kernel (required so that
+equal states are bitwise equal and hashing/dedup is sound):
+  * log slots at positions >= log_len are zero,
+  * msgs bits outside the universe (padding of the last word) are zero,
+  * pending/valSent/role/votedFor use their canonical small encodings.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import FOLLOWER, RaftConfig
+from ..ops.msg_universe import MsgUniverse, get_universe
+
+
+class RaftState(NamedTuple):
+    """Batched checker state; every leaf has leading dim N (the batch)."""
+
+    voted_for: jnp.ndarray  # u8[N, S], 0 = None
+    current_term: jnp.ndarray  # u8[N, S]
+    role: jnp.ndarray  # u8[N, S]
+    log_term: jnp.ndarray  # u8[N, S, L]
+    log_val: jnp.ndarray  # u8[N, S, L]
+    log_len: jnp.ndarray  # u8[N, S] in 1..L
+    match_index: jnp.ndarray  # u8[N, S, S] in 1..L
+    next_index: jnp.ndarray  # u8[N, S, S] in 2..L+1
+    commit_index: jnp.ndarray  # u8[N, S] in 1..L
+    election_count: jnp.ndarray  # u8[N]
+    restart_count: jnp.ndarray  # u8[N]
+    pending: jnp.ndarray  # u8[N, S, S] 0/1
+    val_sent: jnp.ndarray  # u8[N, V] 0 = None, 1 = FALSE
+    msgs: jnp.ndarray  # u32[N, n_words] packed bitmask
+
+    @property
+    def batch(self) -> int:
+        return self.voted_for.shape[0]
+
+
+def init_batch(cfg: RaftConfig, n: int = 1) -> RaftState:
+    """The single initial state (Init — Raft.tla:93-105), tiled n times."""
+    uni = get_universe(cfg)
+    S, L, V = cfg.S, cfg.L, cfg.V
+    u8 = jnp.uint8
+    z = lambda *shape: jnp.zeros((n, *shape), u8)
+    log_term = z(S, L)
+    log_val = z(S, L)
+    return RaftState(
+        voted_for=z(S),
+        current_term=z(S),
+        role=jnp.full((n, S), FOLLOWER, u8),
+        log_term=log_term,  # sentinel entry term 0 at slot 0 (Raft.tla:97)
+        log_val=log_val,
+        log_len=jnp.ones((n, S), u8),
+        match_index=jnp.ones((n, S, S), u8),
+        next_index=jnp.full((n, S, S), 2, u8),
+        commit_index=jnp.ones((n, S), u8),
+        election_count=jnp.zeros((n,), u8),
+        restart_count=jnp.zeros((n,), u8),
+        pending=z(S, S),
+        val_sent=z(V),
+        msgs=jnp.zeros((n, uni.n_words), jnp.uint32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Oracle bridge (host-side, tests and trace pretty-printing only)
+# ---------------------------------------------------------------------------
+
+
+def from_oracle(cfg: RaftConfig, states) -> RaftState:
+    """Encode a list of oracle OStates as a batched RaftState (numpy path)."""
+    uni = get_universe(cfg)
+    S, L, V = cfg.S, cfg.L, cfg.V
+    n = len(states)
+    a = {
+        "voted_for": np.zeros((n, S), np.uint8),
+        "current_term": np.zeros((n, S), np.uint8),
+        "role": np.zeros((n, S), np.uint8),
+        "log_term": np.zeros((n, S, L), np.uint8),
+        "log_val": np.zeros((n, S, L), np.uint8),
+        "log_len": np.zeros((n, S), np.uint8),
+        "match_index": np.zeros((n, S, S), np.uint8),
+        "next_index": np.zeros((n, S, S), np.uint8),
+        "commit_index": np.zeros((n, S), np.uint8),
+        "election_count": np.zeros((n,), np.uint8),
+        "restart_count": np.zeros((n,), np.uint8),
+        "pending": np.zeros((n, S, S), np.uint8),
+        "val_sent": np.zeros((n, V), np.uint8),
+        "msgs": np.zeros((n, uni.n_words), np.uint32),
+    }
+    for i, st in enumerate(states):
+        a["voted_for"][i] = st.voted_for
+        a["current_term"][i] = st.current_term
+        a["role"][i] = st.role
+        for s in range(S):
+            log = st.logs[s]
+            a["log_len"][i, s] = len(log)
+            for j, (t, v) in enumerate(log):
+                a["log_term"][i, s, j] = t
+                a["log_val"][i, s, j] = v
+        a["match_index"][i] = st.match_index
+        a["next_index"][i] = st.next_index
+        a["commit_index"][i] = st.commit_index
+        a["election_count"][i] = st.election_count
+        a["restart_count"][i] = st.restart_count
+        a["pending"][i] = st.pending_response
+        a["val_sent"][i] = st.val_sent
+        a["msgs"][i] = uni.msgs_to_mask(st.msgs)
+    return RaftState(**{k: jnp.asarray(v) for k, v in a.items()})
+
+
+def to_oracle(cfg: RaftConfig, state: RaftState) -> list:
+    """Decode a batched RaftState back to oracle OStates."""
+    from ..oracle.explicit import OState
+
+    uni = get_universe(cfg)
+    S = cfg.S
+    sv = {k: np.asarray(v) for k, v in state._asdict().items()}
+    out = []
+    for i in range(sv["voted_for"].shape[0]):
+        logs = []
+        for s in range(S):
+            ln = int(sv["log_len"][i, s])
+            logs.append(
+                tuple(
+                    (int(sv["log_term"][i, s, j]), int(sv["log_val"][i, s, j]))
+                    for j in range(ln)
+                )
+            )
+        out.append(
+            OState(
+                voted_for=tuple(int(x) for x in sv["voted_for"][i]),
+                current_term=tuple(int(x) for x in sv["current_term"][i]),
+                role=tuple(int(x) for x in sv["role"][i]),
+                logs=tuple(logs),
+                match_index=tuple(tuple(int(x) for x in r) for r in sv["match_index"][i]),
+                next_index=tuple(tuple(int(x) for x in r) for r in sv["next_index"][i]),
+                commit_index=tuple(int(x) for x in sv["commit_index"][i]),
+                msgs=uni.mask_to_msgs(sv["msgs"][i]),
+                election_count=int(sv["election_count"][i]),
+                restart_count=int(sv["restart_count"][i]),
+                pending_response=tuple(tuple(int(x) for x in r) for r in sv["pending"][i]),
+                val_sent=tuple(int(x) for x in sv["val_sent"][i]),
+            )
+        )
+    return out
