@@ -1,0 +1,101 @@
+"""Headline benchmark: distinct states/sec of the TPU checker.
+
+Workload: the reference model (/root/reference/Raft.cfg) checked end to end
+— BFS over the full bounded state space with symmetry + VIEW dedup and the
+Inv invariant, exactly what `./myrun.sh` runs (BASELINE.md config 1/2).
+
+Baseline: the reference publishes no numbers and its checker (TLC) is an
+external Java tool that is not vendored (and cannot be fetched in this
+zero-egress environment), so the recorded CPU baseline is this repo's
+pure-Python oracle — the same semantics, measured on a depth-capped prefix
+of the same workload (BASELINE.md "first measurement task").
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "distinct_states_per_sec",
+   "vs_baseline": N, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def measure_oracle(cfg, budget_s: float = 20.0):
+    """Oracle distinct-states/sec on a depth-capped prefix of the workload."""
+    from tla_raft_tpu.oracle import OracleChecker
+
+    best = None
+    for depth in range(4, 64):
+        t0 = time.monotonic()
+        res = OracleChecker(cfg).run(max_depth=depth)
+        dt = time.monotonic() - t0
+        best = (res.distinct / dt, res.distinct, depth, dt)
+        if dt > budget_s or res.depth < depth:
+            break
+    return best
+
+
+def main():
+    os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.expanduser("~/.cache/tla_raft_tpu_jax")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from tla_raft_tpu.cfgparse import load_raft_config
+    from tla_raft_tpu.engine import JaxChecker
+
+    cfg = load_raft_config(
+        os.environ.get("RAFT_CFG", "/root/reference/Raft.cfg")
+    )
+    max_depth = int(os.environ.get("BENCH_MAX_DEPTH", "0")) or None
+    chunk = int(os.environ.get("BENCH_CHUNK", "256"))
+
+    oracle_rate, o_states, o_depth, o_dt = measure_oracle(cfg)
+
+    # warm-up run compiles every kernel shape (cached persistently), then
+    # the timed run measures steady-state throughput
+    chk = JaxChecker(cfg, chunk=chunk)
+    t0 = time.monotonic()
+    res = chk.run(max_depth=max_depth)
+    dt = time.monotonic() - t0
+    t1 = time.monotonic()
+    res2 = JaxChecker(cfg, chunk=chunk).run(max_depth=max_depth)
+    dt2 = time.monotonic() - t1
+    assert res2.distinct == res.distinct
+    rate = res2.distinct / dt2
+
+    print(
+        json.dumps(
+            {
+                "metric": "raft_cfg_full_check",
+                "value": round(rate, 1),
+                "unit": "distinct_states_per_sec",
+                "vs_baseline": round(rate / oracle_rate, 2),
+                "distinct": res2.distinct,
+                "generated": res2.generated,
+                "depth": res2.depth,
+                "ok": res2.ok,
+                "wall_s": round(dt2, 2),
+                "cold_wall_s": round(dt, 2),
+                "baseline": {
+                    "impl": "python_oracle",
+                    "rate": round(oracle_rate, 1),
+                    "states": o_states,
+                    "depth_cap": o_depth,
+                    "wall_s": round(o_dt, 2),
+                },
+                "device": str(jax.devices()[0]),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

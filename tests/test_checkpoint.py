@@ -1,0 +1,30 @@
+"""Checkpoint/resume: stop a run mid-BFS, reload, continue to the same result
+(TLC's ``-recover states/<id>`` workflow, SURVEY.md §3.5)."""
+
+import os
+
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.oracle import OracleChecker
+
+
+def test_resume_matches_uninterrupted_run(tmp_path):
+    cfg = RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1)
+    want = OracleChecker(cfg).run()
+
+    ckdir = str(tmp_path / "states")
+    partial = JaxChecker(cfg, chunk=64).run(
+        max_depth=4, checkpoint_dir=ckdir, checkpoint_every=1
+    )
+    assert partial.depth == 4
+    ck = os.path.join(ckdir, "latest.npz")
+    assert os.path.exists(ck)
+
+    resumed = JaxChecker(cfg, chunk=64).run(resume_from=ck)
+    assert resumed.ok == want.ok
+    assert resumed.distinct == want.distinct
+    assert resumed.depth == want.depth
+    assert resumed.level_sizes == want.level_sizes
+    # generated counts only the resumed levels' expansions plus the
+    # checkpointed prefix recorded in the snapshot
+    assert resumed.generated == want.generated

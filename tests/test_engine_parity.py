@@ -1,0 +1,69 @@
+"""Full-run differential tests: JaxChecker vs the Python oracle.
+
+The correctness bar from SURVEY.md §7.3: on identical configs the TPU
+engine must report the same distinct-state count, generated count, depth
+and per-level frontier sizes as the oracle (which reproduces TLC's
+semantics), and violation runs must produce valid counterexample traces
+found at the same depth.
+"""
+
+import numpy as np
+import pytest
+
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.oracle import OracleChecker
+from tla_raft_tpu.oracle.explicit import canonical_key, init_state, successors
+
+PARITY_CFGS = [
+    RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1, symmetry=False),
+    RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1, symmetry=True),
+    RaftConfig(n_servers=2, n_vals=1, max_election=2, max_restart=1, symmetry=True),
+    RaftConfig(n_servers=3, n_vals=1, max_election=1, max_restart=0, symmetry=True),
+    RaftConfig(n_servers=3, n_vals=1, max_election=1, max_restart=0, symmetry=False),
+    RaftConfig(n_servers=2, n_vals=1, max_election=2, max_restart=1, use_view=False),
+]
+
+
+@pytest.mark.parametrize(
+    "cfg", PARITY_CFGS, ids=[f"s{c.S}e{c.max_election}{'sym' if c.symmetry else 'full'}{'' if c.use_view else 'noview'}" for c in PARITY_CFGS]
+)
+def test_full_run_parity(cfg):
+    want = OracleChecker(cfg).run()
+    got = JaxChecker(cfg, chunk=64).run()
+    assert got.ok == want.ok
+    assert got.distinct == want.distinct
+    assert got.generated == want.generated
+    assert got.depth == want.depth
+    assert got.level_sizes == want.level_sizes
+
+
+def test_probe_violation_and_trace():
+    """Running a probe's negation finds a violation at the oracle's depth,
+    and the reported trace is a genuine behavior of the spec."""
+    cfg = RaftConfig(
+        n_servers=3, n_vals=1, max_election=1, max_restart=0,
+        invariants=("~RaftCanCommt",),
+    )
+    want = OracleChecker(cfg).run()
+    got = JaxChecker(cfg, chunk=64).run()
+    assert not got.ok and not want.ok
+    assert got.depth == want.depth
+    kind, trace = got.violation
+    assert "RaftCanCommt" in kind
+    assert trace[0][0] == "Init"
+    assert any(ci > 1 for ci in trace[-1][1].commit_index)
+    # every step is a real transition of the spec
+    for (_, a), (act, b) in zip(trace, trace[1:]):
+        keys = {canonical_key(cfg, ch) for _n, _s, _d, ch in successors(cfg, a)}
+        # the replayed child must literally be a successor (full-state match)
+        assert any(ch == b for _n, _s, _d, ch in successors(cfg, a)), act
+    assert trace[1][1] != init_state(cfg)
+
+
+def test_max_depth_cutoff():
+    cfg = RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1)
+    want = OracleChecker(cfg).run(max_depth=4)
+    got = JaxChecker(cfg, chunk=64).run(max_depth=4)
+    assert got.distinct == want.distinct
+    assert got.level_sizes == want.level_sizes

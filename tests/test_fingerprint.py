@@ -1,0 +1,168 @@
+"""Fingerprint kernels vs the oracle's canonical keys.
+
+The bar: on a corpus of reachable states, fingerprint equality must match
+canonical-key equality exactly (both for the VIEW channel and the full-state
+channel), fingerprints must be invariant under server permutations, and the
+numpy reference path must reproduce the device kernel bit-for-bit.
+"""
+
+import numpy as np
+import pytest
+
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.models.raft import encode_np, from_oracle
+from tla_raft_tpu.ops.fingerprint import Fingerprinter
+from tla_raft_tpu.ops.msg_universe import get_universe
+from tla_raft_tpu.oracle.explicit import (
+    OState,
+    canonical_key,
+    init_state,
+    successors,
+)
+
+
+def collect_states(cfg, max_states=600):
+    """BFS a prefix of the state space, keeping full (non-collapsed) states."""
+    seen, order, frontier = set(), [], [init_state(cfg)]
+    seen.add(frontier[0])
+    order.append(frontier[0])
+    while frontier and len(order) < max_states:
+        nxt = []
+        for st in frontier:
+            for _a, _s, _d, child in successors(cfg, st):
+                if child not in seen:
+                    seen.add(child)
+                    order.append(child)
+                    nxt.append(child)
+                if len(order) >= max_states:
+                    break
+            if len(order) >= max_states:
+                break
+        frontier = nxt
+    return order
+
+
+def device_fps(cfg, states):
+    fpr = Fingerprinter(cfg)
+    batch = from_oracle(cfg, states)
+    view, full, _msum = fpr.state_fingerprints(batch)
+    return fpr, np.asarray(view), np.asarray(full)
+
+
+CFGS = [
+    RaftConfig(n_servers=2, n_vals=1, max_election=2, max_restart=1),
+    RaftConfig(n_servers=3, n_vals=2, max_election=2, max_restart=1),
+    RaftConfig(n_servers=3, n_vals=1, max_election=1, max_restart=0, symmetry=False),
+    RaftConfig(n_servers=3, n_vals=1, max_election=1, max_restart=0, use_view=False),
+]
+
+
+@pytest.mark.parametrize("cfg", CFGS, ids=[c.describe()[:40] for c in CFGS])
+def test_fp_equality_matches_canonical_key(cfg):
+    states = collect_states(cfg)
+    _fpr, view, full = device_fps(cfg, states)
+    keys = [canonical_key(cfg, st) for st in states]
+    by_key = {}
+    for i, k in enumerate(keys):
+        by_key.setdefault(k, []).append(i)
+    # same canonical key -> same fp; distinct keys -> distinct fps
+    key_to_fp = {}
+    for k, idxs in by_key.items():
+        fps = {int(view[i]) for i in idxs}
+        assert len(fps) == 1, f"same canonical key produced {len(fps)} fingerprints"
+        key_to_fp[k] = fps.pop()
+    assert len(set(key_to_fp.values())) == len(key_to_fp), "fp collision across keys"
+
+    # full channel: equality must match the no-view canonical key
+    full_cfg = RaftConfig(**{**cfg.__dict__, "use_view": False})
+    fkeys = [canonical_key(full_cfg, st) for st in states]
+    groups = {}
+    for i, k in enumerate(fkeys):
+        groups.setdefault(k, set()).add(int(full[i]))
+    for k, fps in groups.items():
+        assert len(fps) == 1
+    allfps = [next(iter(v)) for v in groups.values()]
+    assert len(set(allfps)) == len(allfps)
+
+
+def test_permutation_invariance():
+    cfg = RaftConfig(n_servers=3, n_vals=2, max_election=2, max_restart=1)
+    states = collect_states(cfg, max_states=200)
+    _, view, full = device_fps(cfg, states)
+    # permute every state by a fixed non-trivial permutation
+    p = (2, 3, 1)
+    inv = [0] * 3
+    for s in range(1, 4):
+        inv[p[s - 1] - 1] = s
+
+    def pv(x):
+        return p[x - 1] if x else 0
+
+    def permute(st: OState) -> OState:
+        S = 3
+        return OState(
+            voted_for=tuple(pv(st.voted_for[inv[i] - 1]) for i in range(S)),
+            current_term=tuple(st.current_term[inv[i] - 1] for i in range(S)),
+            role=tuple(st.role[inv[i] - 1] for i in range(S)),
+            logs=tuple(st.logs[inv[i] - 1] for i in range(S)),
+            match_index=tuple(
+                tuple(st.match_index[inv[i] - 1][inv[j] - 1] for j in range(S)) for i in range(S)
+            ),
+            next_index=tuple(
+                tuple(st.next_index[inv[i] - 1][inv[j] - 1] for j in range(S)) for i in range(S)
+            ),
+            commit_index=tuple(st.commit_index[inv[i] - 1] for i in range(S)),
+            msgs=frozenset((m[0], pv(m[1]), pv(m[2])) + m[3:] for m in st.msgs),
+            election_count=st.election_count,
+            restart_count=st.restart_count,
+            pending_response=tuple(
+                tuple(st.pending_response[inv[i] - 1][inv[j] - 1] for j in range(S))
+                for i in range(S)
+            ),
+            val_sent=st.val_sent,
+        )
+
+    _, pview, pfull = device_fps(cfg, [permute(st) for st in states])
+    assert np.array_equal(view, pview)
+    assert np.array_equal(full, pfull)
+
+
+def test_numpy_reference_path_matches_device():
+    cfg = RaftConfig(n_servers=3, n_vals=2, max_election=2, max_restart=1)
+    states = collect_states(cfg, max_states=150)
+    fpr, view, full = device_fps(cfg, states)
+    uni = get_universe(cfg)
+    arrs = encode_np(cfg, states)
+    bits = uni.unpack_bits(arrs["msgs"])
+    nview, nfull = fpr.fingerprints_np(arrs, bits)
+    assert np.array_equal(view, nview)
+    assert np.array_equal(full, nfull)
+
+
+def test_incremental_child_hash_matches_full():
+    """delta_hash(parent msum, added ids) == full hash of the child state."""
+    import jax.numpy as jnp
+
+    cfg = RaftConfig(n_servers=3, n_vals=1, max_election=2, max_restart=1)
+    fpr = Fingerprinter(cfg)
+    uni = get_universe(cfg)
+    states = collect_states(cfg, max_states=120)
+    pairs = []  # (parent, child, added ids)
+    for st in states[:60]:
+        for _a, _s, _d, child in successors(cfg, st):
+            added = child.msgs - st.msgs
+            if len(pairs) < 100:
+                pairs.append((st, child, sorted(uni.msg_to_id(m) for m in added)))
+    parents = from_oracle(cfg, [p for p, _, _ in pairs])
+    children = from_oracle(cfg, [c for _, c, _ in pairs])
+    A = max((len(ids) for _, _, ids in pairs), default=1) or 1
+    ids = np.full((len(pairs), A), -1, np.int64)
+    for i, (_, _, add) in enumerate(pairs):
+        ids[i, : len(add)] = add
+    _, _, msum = fpr.state_fingerprints(parents)
+    feats = fpr.spec.features(children)
+    live = jnp.asarray(ids >= 0)
+    cv, cf = fpr.child_fingerprints(feats, msum, jnp.asarray(ids), live)
+    ev, ef, _ = fpr.state_fingerprints(children)
+    assert np.array_equal(np.asarray(cv), np.asarray(ev))
+    assert np.array_equal(np.asarray(cf), np.asarray(ef))

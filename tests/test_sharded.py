@@ -1,0 +1,35 @@
+"""Distributed (mesh-sharded) checker vs the oracle on a virtual CPU mesh.
+
+The conftest forces 8 virtual CPU devices; the distributed level step must
+produce identical distinct/generated/depth/level-size numbers as the
+oracle for any device count — the fingerprint exchange and the
+deterministic representative rule make the result mesh-shape-invariant.
+"""
+
+import jax
+import pytest
+
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.oracle import OracleChecker
+from tla_raft_tpu.parallel import ShardedChecker, make_mesh
+
+CFGS = [
+    RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1),
+    RaftConfig(n_servers=3, n_vals=1, max_election=1, max_restart=0),
+]
+
+
+@pytest.mark.parametrize("ndev", [2, 8])
+@pytest.mark.parametrize("cfg", CFGS, ids=["s2", "s3"])
+def test_sharded_parity(cfg, ndev):
+    if len(jax.devices()) < ndev:
+        pytest.skip("not enough virtual devices")
+    want = OracleChecker(cfg).run()
+    mesh = make_mesh(ndev)
+    got_distinct, got_generated, got_depth, got_levels = ShardedChecker(
+        cfg, mesh, cap_x=512
+    ).run()
+    assert got_distinct == want.distinct
+    assert got_generated == want.generated
+    assert got_depth == want.depth
+    assert got_levels == want.level_sizes

@@ -1,0 +1,125 @@
+"""Successor kernel vs the oracle, state by state.
+
+For a corpus of reachable states the kernel's fan-out must reproduce the
+oracle's ``successors`` exactly: same multiset of successor states (compared
+by canonical fingerprint, with slot multiplicities standing in for the
+collapsed message witnesses), same generated-count, same split-brain abort
+behavior; and pass-2 materialization must rebuild bit-identical states whose
+recomputed fingerprints equal the pass-1 incremental ones.
+"""
+
+import collections
+
+import numpy as np
+import pytest
+
+from tla_raft_tpu.config import APPEND_REQ, LEADER, RaftConfig
+from tla_raft_tpu.models.raft import from_oracle, to_oracle
+from tla_raft_tpu.ops.fingerprint import Fingerprinter
+from tla_raft_tpu.ops.successor import SuccessorKernel
+from tla_raft_tpu.oracle.explicit import (
+    SplitBrainAbort,
+    canonical_key,
+    init_state,
+    successors,
+)
+
+CFGS = [
+    RaftConfig(n_servers=2, n_vals=1, max_election=2, max_restart=1),
+    RaftConfig(n_servers=3, n_vals=2, max_election=2, max_restart=1),
+]
+
+
+def collect(cfg, n):
+    seen, order, frontier = {init_state(cfg)}, [init_state(cfg)], [init_state(cfg)]
+    while frontier and len(order) < n:
+        nxt = []
+        for st in frontier:
+            for _a, _s, _d, ch in successors(cfg, st):
+                if ch not in seen:
+                    seen.add(ch)
+                    order.append(ch)
+                    nxt.append(ch)
+        frontier = nxt
+    return order[:n]
+
+
+@pytest.mark.parametrize("cfg", CFGS, ids=["s2", "s3"])
+def test_expand_matches_oracle(cfg):
+    kern = SuccessorKernel(cfg)
+    fpr = kern.fpr
+    states = collect(cfg, 140)
+    batch = from_oracle(cfg, states)
+    _, _, msum = fpr.state_fingerprints(batch)
+    exp = kern.expand(batch, msum)
+    valid = np.asarray(exp.valid)
+    mult = np.asarray(exp.mult)
+    fpv = np.asarray(exp.fp_view)
+    assert not np.asarray(exp.abort).any()
+
+    all_succs = [successors(cfg, st) for st in states]
+    flat_children = [ch for ss in all_succs for _a, _s, _d, ch in ss]
+    ev, _, _ = fpr.state_fingerprints(from_oracle(cfg, flat_children))
+    ev = np.asarray(ev)
+    off = 0
+    for i, succs in enumerate(all_succs):
+        # generated-count parity: slot multiplicities cover every concrete
+        # message witness the oracle enumerates (SURVEY.md §3.2).
+        assert int(mult[i][valid[i]].sum()) == len(succs), f"state {i}"
+        # multiset of successors by canonical view fingerprint
+        want = collections.Counter(ev[off : off + len(succs)].tolist())
+        off += len(succs)
+        got = collections.Counter()
+        for k in np.nonzero(valid[i])[0]:
+            got[int(fpv[i, k])] += int(mult[i, k])
+        assert got == want, f"state {i}"
+
+
+@pytest.mark.parametrize("cfg", CFGS, ids=["s2", "s3"])
+def test_materialize_matches_oracle(cfg):
+    kern = SuccessorKernel(cfg)
+    fpr = kern.fpr
+    states = collect(cfg, 60)
+    batch = from_oracle(cfg, states)
+    _, _, msum = fpr.state_fingerprints(batch)
+    exp = kern.expand(batch, msum)
+    valid = np.asarray(exp.valid)
+
+    import jax
+    import jax.numpy as jnp
+
+    # one flat materialize call over every valid (state, slot) pair
+    pidx, slots = np.nonzero(valid)
+    parents = jax.tree.map(lambda x: x[pidx], batch)
+    children = kern.materialize(parents, jnp.asarray(slots))
+    decoded = to_oracle(cfg, children)
+    for i, st in enumerate(states):
+        got = {canonical_key(cfg, decoded[j]) for j in np.nonzero(pidx == i)[0]}
+        want = {canonical_key(cfg, ch) for _a, _s, _d, ch in successors(cfg, st)}
+        assert got == want, f"state {i}"
+    # pass-2 states re-fingerprint to the pass-1 incremental values
+    rv, rf, _ = fpr.state_fingerprints(children)
+    assert np.array_equal(np.asarray(rv), np.asarray(exp.fp_view)[pidx, slots])
+    assert np.array_equal(np.asarray(rf), np.asarray(exp.fp_full)[pidx, slots])
+
+
+def test_split_brain_abort_flag():
+    """A Leader receiving a same-term AppendReq aborts (Raft.tla:185)."""
+    cfg = RaftConfig(n_servers=3, n_vals=1, max_election=2, max_restart=1)
+    kern = SuccessorKernel(cfg)
+    # find a reachable state with a Leader
+    lead_st = next(
+        st for st in collect(cfg, 300) if LEADER in st.role
+    )
+    s = lead_st.role.index(LEADER) + 1
+    other = 1 if s != 1 else 2
+    evil = lead_st._replace(
+        msgs=lead_st.msgs
+        | {(APPEND_REQ, other, s, lead_st.current_term[s - 1], 1, 0, (), 1)}
+    )
+    with pytest.raises(SplitBrainAbort):
+        successors(cfg, evil)
+    batch = from_oracle(cfg, [evil])
+    _, _, msum = kern.fpr.state_fingerprints(batch)
+    exp = kern.expand(batch, msum)
+    assert bool(np.asarray(exp.abort)[0])

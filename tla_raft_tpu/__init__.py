@@ -19,4 +19,9 @@ as data-parallel JAX/XLA kernels:
   publishes no numbers and TLC itself (a Java tool) is not vendored.
 """
 
+# NOTE: importing the bare package stays jax-free (the cfg parser and the
+# pure-Python oracle have no accelerator dependency).  The kernel modules
+# (ops/fingerprint.py and everything above it) enable jax x64 at *their*
+# import, before any u64 fingerprint kernel is traced.
+
 __version__ = "0.1.0"

@@ -1,0 +1,224 @@
+"""CLI entry point: the L4 launcher layer, TPU-native.
+
+The reference's launcher is ``myrun.sh`` (/root/reference/myrun.sh:3):
+``java ... tla2tools.jar -deadlock -workers 4 -config Raft.cfg Raft.tla $@
+2>&1 | tee raft.log``.  This module is the checker it launches when
+``-backend=jax`` is selected: it parses the same ``.cfg`` file
+(cfgparse.py), runs the TPU BFS engine (engine/bfs.py) or the pure-Python
+oracle, prints TLC-shaped progress/result lines, and tees everything to
+``raft.log`` — keeping the reference's observability contract (grep-able
+state counts + verdict, SURVEY.md §5 "metrics/logging").
+
+Usage:
+  python -m tla_raft_tpu.check --config /root/reference/Raft.cfg \
+      [--backend jax|oracle] [--max-depth N] [--chunk N] \
+      [--invariant NAME]... [--no-symmetry] [--no-view] \
+      [--checkpoint-dir states] [--recover states/latest.npz] \
+      [--log raft.log] [--servers N] [--vals N] [--max-election N] \
+      [--max-restart N]
+
+Flags mirror TLC where an analog exists: ``--workers`` is accepted and
+ignored (parallelism is the device mesh, not a thread count);
+``--recover`` matches TLC's ``-recover``; deadlock checking is disabled
+with no off switch, matching the pinned ``-deadlock`` flag.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+from .config import MSG_TYPE_NAMES, ROLE_NAMES, RaftConfig
+from .cfgparse import load_raft_config
+
+
+class Tee:
+    def __init__(self, *streams):
+        self.streams = streams
+
+    def write(self, s):
+        for st in self.streams:
+            st.write(s)
+
+    def flush(self):
+        for st in self.streams:
+            st.flush()
+
+
+def format_state(cfg: RaftConfig, st) -> str:
+    """Pretty-print one oracle OState, TLA-style."""
+    S = cfg.S
+
+    def server_fn(vals, fmt=str):
+        return "[" + ", ".join(f"s{i + 1} |-> {fmt(v)}" for i, v in enumerate(vals)) + "]"
+
+    def fmt_vote(v):
+        return f"s{v}" if v else "None"
+
+    def fmt_log(log):
+        return "<<" + ", ".join(f"[term |-> {t}, val |-> {'v%d' % v if v else 'None'}]" for t, v in log) + ">>"
+
+    def fmt_msg(m):
+        t = MSG_TYPE_NAMES[m[0]]
+        if m[0] == 0:  # VoteReq
+            return (f"[type |-> {t}, src |-> s{m[1]}, dst |-> s{m[2]}, term |-> {m[3]}, "
+                    f"lastLogIndex |-> {m[4]}, lastLogTerm |-> {m[5]}]")
+        if m[0] == 1:  # VoteResp
+            return f"[type |-> {t}, src |-> s{m[1]}, dst |-> s{m[2]}, term |-> {m[3]}]"
+        if m[0] == 2:  # AppendReq
+            ent = ", ".join(f"[term |-> {et}, val |-> v{ev}]" for et, ev in m[6])
+            return (f"[type |-> {t}, src |-> s{m[1]}, dst |-> s{m[2]}, term |-> {m[3]}, "
+                    f"prevLogIndex |-> {m[4]}, prevLogTerm |-> {m[5]}, "
+                    f"entries |-> <<{ent}>>, leaderCommit |-> {m[7]}]")
+        return (f"[type |-> {t}, src |-> s{m[1]}, dst |-> s{m[2]}, term |-> {m[3]}, "
+                f"prevLogIndex |-> {m[4]}, succ |-> {'TRUE' if m[5] else 'FALSE'}]")
+
+    lines = [
+        f"/\\ votedFor = {server_fn(st.voted_for, fmt_vote)}",
+        f"/\\ currentTerm = {server_fn(st.current_term)}",
+        f"/\\ role = {server_fn(st.role, lambda r: ROLE_NAMES[r])}",
+        f"/\\ logs = {server_fn(st.logs, fmt_log)}",
+        f"/\\ matchIndex = {server_fn(st.match_index, lambda r: '[' + ', '.join(f's{j + 1} |-> {x}' for j, x in enumerate(r)) + ']')}",
+        f"/\\ nextIndex = {server_fn(st.next_index, lambda r: '[' + ', '.join(f's{j + 1} |-> {x}' for j, x in enumerate(r)) + ']')}",
+        f"/\\ commitIndex = {server_fn(st.commit_index)}",
+        "/\\ msgs = {" + ",\n            ".join(fmt_msg(m) for m in sorted(st.msgs)) + "}",
+        f"/\\ electionCount = {st.election_count}",
+        f"/\\ restartCount = {st.restart_count}",
+        f"/\\ valSent = [" + ", ".join(
+            f"v{i + 1} |-> {'None' if v == 0 else 'FALSE'}" for i, v in enumerate(st.val_sent)
+        ) + "]",
+    ]
+    return "\n".join(lines)
+
+
+def print_trace(cfg: RaftConfig, trace, out):
+    print("The behavior up to this point is:", file=out)
+    for i, (action, st) in enumerate(trace):
+        label = "Initial predicate" if action == "Init" else action
+        print(f"\nSTATE {i + 1}: <{label}>", file=out)
+        print(format_state(cfg, st), file=out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tla_raft_tpu.check")
+    p.add_argument("--config", default="/root/reference/Raft.cfg",
+                   help="TLC .cfg file (single source of truth for constants)")
+    p.add_argument("--backend", choices=("jax", "oracle"), default="jax")
+    p.add_argument("--workers", type=int, default=None,
+                   help="accepted for myrun.sh compatibility; ignored")
+    p.add_argument("--max-depth", type=int, default=None)
+    p.add_argument("--chunk", type=int, default=512)
+    p.add_argument("--invariant", action="append", default=None,
+                   help="override INVARIANT (repeatable; ~Name negates)")
+    p.add_argument("--no-symmetry", action="store_true")
+    p.add_argument("--no-view", action="store_true")
+    p.add_argument("--servers", type=int, default=None, help="override |Servers|")
+    p.add_argument("--vals", type=int, default=None, help="override |Vals|")
+    p.add_argument("--max-election", type=int, default=None)
+    p.add_argument("--max-restart", type=int, default=None)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=1)
+    p.add_argument("--recover", default=None, help="resume from a checkpoint .npz")
+    p.add_argument("--log", default="raft.log")
+    p.add_argument("--json", action="store_true", help="emit a final JSON summary line")
+    args = p.parse_args(argv)
+
+    cfg = load_raft_config(args.config)
+    overrides = {}
+    if args.invariant:
+        overrides["invariants"] = tuple(args.invariant)
+    if args.no_symmetry:
+        overrides["symmetry"] = False
+    if args.no_view:
+        overrides["use_view"] = False
+    if args.servers is not None:
+        overrides["n_servers"] = args.servers
+    if args.vals is not None:
+        overrides["n_vals"] = args.vals
+    if args.max_election is not None:
+        overrides["max_election"] = args.max_election
+    if args.max_restart is not None:
+        overrides["max_restart"] = args.max_restart
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+
+    logf = open(args.log, "w") if args.log and args.log != "-" else None
+    out = Tee(sys.stdout, logf) if logf else sys.stdout
+    t0 = time.monotonic()
+    print(f"tla-raft-tpu checker: backend={args.backend}", file=out)
+    print(f"Config {args.config}: {cfg.describe()}", file=out)
+
+    if args.backend == "oracle":
+        from .oracle import OracleChecker
+
+        res = OracleChecker(cfg).run(max_depth=args.max_depth)
+    else:
+        import jax
+
+        # persistent compile cache: the expand kernel is large and its
+        # compile (remote on tunneled TPUs) dominates cold-start time
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.expanduser("~/.cache/tla_raft_tpu_jax"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+        from .engine import JaxChecker
+
+        print(f"Devices: {jax.devices()}", file=out)
+
+        def progress(s):
+            rate = s["distinct"] / max(s["elapsed"], 1e-9)
+            print(
+                f"Progress: level {s['level']}, frontier {s['frontier']}, "
+                f"distinct {s['distinct']}, generated {s['generated']}, "
+                f"{rate:,.0f} states/s",
+                file=out,
+            )
+            out.flush()
+
+        res = JaxChecker(cfg, chunk=args.chunk, progress=progress).run(
+            max_depth=args.max_depth,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            resume_from=args.recover,
+        )
+
+    dt = time.monotonic() - t0
+    print(file=out)
+    if res.ok:
+        print("Model checking completed. No error has been found.", file=out)
+    else:
+        kind, trace = res.violation
+        print(f"Error: {kind}.", file=out)
+        print_trace(cfg, trace, out)
+    print(
+        f"{res.generated} states generated, {res.distinct} distinct states "
+        f"found, depth {res.depth}.",
+        file=out,
+    )
+    print(f"Finished in {dt:.1f}s ({res.distinct / max(dt, 1e-9):,.0f} distinct states/s).", file=out)
+    if args.json:
+        print(
+            json.dumps(
+                dict(
+                    ok=res.ok,
+                    distinct=res.distinct,
+                    generated=res.generated,
+                    depth=res.depth,
+                    seconds=round(dt, 3),
+                )
+            ),
+            file=out,
+        )
+    if logf:
+        logf.close()
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

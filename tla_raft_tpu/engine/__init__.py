@@ -1,0 +1,3 @@
+"""The TPU checker engine: BFS driver, dedup store, invariants, traces."""
+
+from .bfs import JaxChecker  # noqa: F401

@@ -1,0 +1,382 @@
+"""Level-synchronous BFS on device — TLC's exploration engine, TPU-native.
+
+Replaces the reference checker's core runtime (SURVEY.md §3.1: the BFS
+loop, worker pool, FPSet dedup table, invariant evaluation, trace
+reconstruction and checkpointing of the external TLC jar driven by
+/root/reference/myrun.sh:3) with:
+
+* a **frontier** of full states held as padded struct-of-array tensors,
+* the successor kernel's masked fan-out (ops/successor.py) run in chunks,
+* **dedup** by sorted fingerprints: one lexsort per level over the
+  (fp_view, fp_full, payload) candidate triple picks a canonical
+  representative per new view fingerprint (min fp_full — the
+  deterministic refinement of TLC's first-writer-wins, see
+  oracle/explicit.py), then a ``searchsorted`` against the sorted
+  visited-fingerprint store filters known states,
+* **materialization** of only the surviving (parent, slot) pairs,
+* batched invariant kernels (engine/invariants.py) on each new level,
+* per-level (parent, slot) spill to the host for counterexample traces
+  (SURVEY.md §3.4: TLC's predecessor-chain walk),
+* per-level snapshots for checkpoint/resume (SURVEY.md §3.5: TLC's
+  ``states/`` metadir + ``-recover``).
+
+Deadlock states (no action enabled) are not reported, matching the
+``-deadlock`` flag in myrun.sh:3 which *disables* deadlock checking.
+
+All device computations run at power-of-two padded shapes so XLA compiles
+a logarithmic number of program variants; every array is explicitly
+dtyped (u8 state, u64 fingerprints, i64 payloads).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import RaftConfig
+from ..models.raft import RaftState, init_batch, to_oracle
+from ..ops.fingerprint import FP_SENTINEL
+from ..ops.successor import SuccessorKernel, get_kernel
+from .invariants import resolve_invariant_kernel
+
+U64 = jnp.uint64
+I64 = jnp.int64
+SENT = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+class CheckResult(NamedTuple):
+    """Same shape as oracle.explicit.CheckResult for differential tests."""
+
+    ok: bool
+    distinct: int
+    generated: int
+    depth: int
+    level_sizes: tuple[int, ...]
+    violation: tuple | None  # (kind, trace=[(action, OState), ...])
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+def _cap4(n: int) -> int:
+    """Next power of 4: capacities quantize coarser so the checker compiles
+    ~half as many program shapes (remote TPU compiles are minutes each)."""
+    c = 1
+    while c < n:
+        c <<= 2
+    return c
+
+
+def _pad_axis0(x: jnp.ndarray, cap: int) -> jnp.ndarray:
+    pad = cap - x.shape[0]
+    if pad <= 0:
+        return x[:cap]
+    return jnp.concatenate([x, jnp.zeros((pad, *x.shape[1:]), x.dtype)])
+
+
+def _pad_tree(st: RaftState, cap: int) -> RaftState:
+    return jax.tree.map(lambda x: _pad_axis0(x, cap), st)
+
+
+@jax.jit
+def _dedup(fps_view, fps_full, payload, visited):
+    """Level dedup: sort candidates, pick representatives, drop seen.
+
+    fps_view/full u64[C] (SENT where invalid), payload i64[C] = parent*K+slot,
+    visited u64[V] sorted ascending with SENT padding.  Returns
+    (n_new, new_fps u64[C] view-sorted then SENT-padded, new_payload i64[C]).
+    """
+    order = jnp.lexsort((payload, fps_full, fps_view))
+    sv = fps_view[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), sv[1:] != sv[:-1]])
+    pos = jnp.searchsorted(visited, sv)
+    hit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == sv
+    new = first & (sv != SENT) & ~hit
+    n_new = new.sum()
+    comp = jnp.argsort(~new, stable=True)
+    keep = jnp.arange(sv.shape[0]) < n_new
+    return (
+        n_new,
+        jnp.where(keep, sv[comp], SENT),
+        jnp.where(keep, payload[order][comp], -1),
+    )
+
+
+@jax.jit
+def _merge_sorted(visited, new_fps):
+    """Insert a level's new fingerprints into the sorted store."""
+    return jnp.sort(jnp.concatenate([visited, new_fps]))
+
+
+class JaxChecker:
+    """The TPU model checker for one RaftConfig.
+
+    Parameters:
+      chunk: max parents expanded per kernel launch (memory knob; the
+        per-launch working set is ~chunk * K * (F + hash) bytes).
+      progress: optional callable(level_stats_dict) for per-level logging.
+    """
+
+    def __init__(
+        self,
+        cfg: RaftConfig,
+        chunk: int = 512,
+        progress: Callable[[dict], None] | None = None,
+        host_store=None,
+    ):
+        self.cfg = cfg
+        self.kern: SuccessorKernel = get_kernel(cfg)
+        self.fpr = self.kern.fpr
+        self.K = self.kern.K
+        self.chunk = chunk
+        self.progress = progress
+        # optional native external-memory visited store (native/fpstore.cpp);
+        # when set, the device keeps no visited table at all — the level's
+        # deduped candidates are filtered through the host store instead
+        self.host_store = host_store
+        self.inv_fns = [
+            (n, resolve_invariant_kernel(n)) for n in cfg.invariants
+        ]
+        self._gather_mat = jax.jit(self._gather_materialize)
+
+    # -- device helpers ----------------------------------------------------
+
+    def _gather_materialize(self, frontier: RaftState, pidx, slots):
+        parents = jax.tree.map(lambda x: x[pidx], frontier)
+        children = self.kern.materialize(parents, slots)
+        msum = self.fpr.msg_hash(children.msgs)
+        return children, msum
+
+    def _check_invariants(self, children: RaftState, n_valid: int):
+        """Returns (all_ok, first_bad_index, bad_name) on the host."""
+        N = children.voted_for.shape[0]
+        in_range = np.arange(N) < n_valid
+        for name, fn in self.inv_fns:
+            ok = np.asarray(fn(self.cfg, children, self.kern.tables))
+            bad = in_range & ~ok
+            if bad.any():
+                return False, int(np.nonzero(bad)[0][0]), name
+        return True, -1, None
+
+    # -- trace reconstruction ---------------------------------------------
+
+    def _trace(self, levels: list[tuple[np.ndarray, np.ndarray]], level: int, idx: int):
+        """Walk (parent, slot) spills back to Init, then replay forward.
+
+        levels[d] = (pidx, slot) arrays for the states created at depth d+1;
+        ``idx`` indexes into level ``level``'s arrays (level 0 = init).
+        """
+        chain = []  # slots to apply, init -> violation
+        d, j = level, idx
+        while d > 0:
+            pidx, slots = levels[d - 1]
+            chain.append(int(slots[j]))
+            j = int(pidx[j])
+            d -= 1
+        chain.reverse()
+        st = init_batch(self.cfg, 1)
+        out = [("Init", to_oracle(self.cfg, st)[0])]
+        for slot in chain:
+            st = self.kern.materialize(st, jnp.asarray([slot], I64))
+            fam = int(self.kern.slot_family[slot])
+            name = self.kern.families[fam][0]
+            server = int(self.kern.slot_coords[slot, 0]) + 1
+            out.append((f"{name}({server})", to_oracle(self.cfg, st)[0]))
+        return out
+
+    # -- checkpoint / resume (TLC's states/ metadir + -recover) ------------
+
+    def _save_checkpoint(self, path, frontier, msum, visited, n_f, distinct,
+                         generated, depth, level_sizes, trace_levels):
+        arrs = {f"st_{k}": np.asarray(v) for k, v in frontier._asdict().items()}
+        for i, (p, s) in enumerate(trace_levels):
+            arrs[f"trace_p{i}"] = p
+            arrs[f"trace_s{i}"] = s
+        tmp = f"{path}.tmp.npz"
+        np.savez_compressed(
+            tmp,
+            msum=np.asarray(msum),
+            visited=np.asarray(visited),
+            meta=np.asarray([n_f, distinct, generated, depth], np.int64),
+            level_sizes=np.asarray(level_sizes, np.int64),
+            n_trace=np.asarray([len(trace_levels)], np.int64),
+            **arrs,
+        )
+        os.replace(tmp, path)
+
+    @staticmethod
+    def _load_checkpoint(path):
+        z = np.load(path)
+        frontier = RaftState(
+            **{k[3:]: jnp.asarray(z[k]) for k in z.files if k.startswith("st_")}
+        )
+        n_f, distinct, generated, depth = (int(x) for x in z["meta"])
+        trace_levels = [
+            (z[f"trace_p{i}"], z[f"trace_s{i}"]) for i in range(int(z["n_trace"][0]))
+        ]
+        return dict(
+            frontier=frontier,
+            msum=jnp.asarray(z["msum"]),
+            visited=jnp.asarray(z["visited"]),
+            n_f=n_f,
+            distinct=distinct,
+            generated=generated,
+            depth=depth,
+            level_sizes=list(int(x) for x in z["level_sizes"]),
+            trace_levels=trace_levels,
+        )
+
+    # -- the main loop -----------------------------------------------------
+
+    def run(
+        self,
+        max_depth: int | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
+        resume_from: str | None = None,
+    ) -> CheckResult:
+        cfg = self.cfg
+        K = self.K
+        t0 = time.monotonic()
+
+        if resume_from is not None:
+            ck = self._load_checkpoint(resume_from)
+            frontier, msum, visited = ck["frontier"], ck["msum"], ck["visited"]
+            n_f, distinct, generated = ck["n_f"], ck["distinct"], ck["generated"]
+            depth, level_sizes, trace_levels = (
+                ck["depth"], ck["level_sizes"], ck["trace_levels"],
+            )
+        else:
+            frontier = init_batch(cfg, 1)
+            n_f = 1
+            fv, _ff, msum = self.fpr.state_fingerprints(frontier)
+            if self.host_store is not None:
+                self.host_store.insert(np.asarray(fv.astype(U64)))
+                visited = jnp.full((64,), SENT, U64)
+            else:
+                visited = jnp.sort(
+                    jnp.concatenate([fv.astype(U64), jnp.full((63,), SENT, U64)])
+                )
+            distinct = 1
+            generated = 0
+            level_sizes = [1]
+            depth = 0
+            trace_levels = []
+
+            ok, bad_idx, bad_name = self._check_invariants(frontier, 1)
+            if not ok:
+                return CheckResult(
+                    False, 1, 0, 0, (1,),
+                    (
+                        f"Invariant {bad_name} is violated",
+                        self._trace(trace_levels, 0, 0),
+                    ),
+                )
+
+        while n_f > 0:
+            if max_depth is not None and depth >= max_depth:
+                break
+            # --- expand the frontier in chunks, collect fingerprints ----
+            cap_f = frontier.voted_for.shape[0]
+            views, fulls, payloads, mults = [], [], [], []
+            abort_at = -1
+            for start in range(0, cap_f, self.chunk):
+                stop = min(start + self.chunk, cap_f)
+                part = jax.tree.map(lambda x: x[start:stop], frontier)
+                exp = self.kern.expand(part, msum[start:stop])
+                in_range = (jnp.arange(start, stop) < n_f)[:, None]
+                valid = exp.valid & in_range
+                views.append(jnp.where(valid, exp.fp_view, SENT).ravel())
+                fulls.append(jnp.where(valid, exp.fp_full, SENT).ravel())
+                base = (jnp.arange(start, stop, dtype=I64) * K)[:, None]
+                payloads.append((base + jnp.arange(K, dtype=I64)[None]).ravel())
+                mults.append(jnp.where(valid, exp.mult, 0).astype(I64).sum())
+                ab = np.asarray(exp.abort & in_range[:, 0])
+                if ab.any():
+                    abort_at = start + int(np.nonzero(ab)[0][0])
+                    break
+            if abort_at >= 0:
+                return CheckResult(
+                    False, distinct, generated, depth, tuple(level_sizes),
+                    (
+                        'Assert "split brain" (Raft.tla:185)',
+                        self._trace(trace_levels, depth, abort_at),
+                    ),
+                )
+            fps_view = jnp.concatenate(views)
+            fps_full = jnp.concatenate(fulls)
+            payload = jnp.concatenate(payloads)
+            generated += int(sum(int(m) for m in mults))
+
+            # --- dedup against visited + within level -------------------
+            n_new_dev, new_fps, new_payload = _dedup(fps_view, fps_full, payload, visited)
+            n_new = int(n_new_dev)
+            if self.host_store is not None and n_new:
+                fps_np = np.asarray(new_fps[:n_new])
+                is_new = self.host_store.insert(fps_np)
+                pay_np = np.asarray(new_payload[:n_new])[is_new]
+                n_new = len(pay_np)
+            else:
+                pay_np = np.asarray(new_payload[:n_new])
+            if n_new == 0:
+                break
+
+            # --- materialize the survivors ------------------------------
+            # never shrink below one chunk: keeps the expand kernel at one
+            # compiled shape instead of one per pow2 frontier size
+            cap_c = max(_cap4(n_new), self.chunk)
+            pidx_np = pay_np // K
+            slot_np = pay_np % K
+            pidx = _pad_axis0(jnp.asarray(pidx_np, I64), cap_c)
+            slots = _pad_axis0(jnp.asarray(slot_np, I64), cap_c)
+            children, child_msum = self._gather_mat(frontier, pidx, slots)
+
+            # --- bookkeeping, invariants, store merge -------------------
+            trace_levels.append((pidx_np.astype(np.int64), slot_np.astype(np.int64)))
+            distinct += n_new
+            level_sizes.append(n_new)
+            depth += 1
+
+            ok, bad_idx, bad_name = self._check_invariants(children, n_new)
+
+            if self.host_store is None:
+                # merge, then trim the store to a pow2 capacity >= distinct
+                # (the merge input carries C-n_new sentinel padding slots)
+                visited = _merge_sorted(visited, new_fps)[: _cap4(distinct + 1)]
+            frontier, msum, n_f = children, child_msum, n_new
+
+            if self.progress is not None:
+                self.progress(
+                    dict(
+                        level=depth,
+                        frontier=n_new,
+                        distinct=distinct,
+                        generated=generated,
+                        elapsed=time.monotonic() - t0,
+                    )
+                )
+            if checkpoint_dir and checkpoint_every and depth % checkpoint_every == 0:
+                os.makedirs(checkpoint_dir, exist_ok=True)
+                self._save_checkpoint(
+                    os.path.join(checkpoint_dir, "latest.npz"), frontier, msum,
+                    visited, n_f, distinct, generated, depth, level_sizes,
+                    trace_levels,
+                )
+            if not ok:
+                return CheckResult(
+                    False, distinct, generated, depth, tuple(level_sizes),
+                    (
+                        f"Invariant {bad_name} is violated",
+                        self._trace(trace_levels, depth, bad_idx),
+                    ),
+                )
+
+        return CheckResult(
+            True, distinct, generated, depth, tuple(level_sizes), None
+        )

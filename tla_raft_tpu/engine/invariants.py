@@ -1,0 +1,156 @@
+"""Batched invariant and probe kernels (Raft.tla:432-507).
+
+Every predicate evaluates a whole batch of states at once -> bool[N]
+(True = holds).  ``Inv`` (Raft.tla:502) binds LeaderHasAllCommittedEntries
+(Raft.tla:491-499), the single invariant the reference checks
+(Raft.cfg:33-34).  The rest are the reference's debug probes — predicates
+deliberately written to be *violated* to prove reachability (SURVEY.md
+§4.3); run them through the ``~name`` negation extension to reproduce that
+workflow.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..config import CANDIDATE, FOLLOWER, LEADER, RaftConfig
+
+I32 = jnp.int32
+
+
+def leader_has_all_committed_entries(cfg: RaftConfig, st, tables=None):
+    """LeaderHasAllCommittedEntries — Raft.tla:491-499.
+
+    Either no Leader exists, or ∃ a Leader l such that no other server p
+    with currentTerm[p] <= currentTerm[l] commits past l's log or commits
+    an entry differing from l's.  Note the spec's ∃-quantifier over
+    leaders (one good leader satisfies it) — reproduced exactly.
+    """
+    S, L = cfg.S, cfg.L
+    ct = st.current_term.astype(I32)
+    ci = st.commit_index.astype(I32)
+    ll = st.log_len.astype(I32)
+    is_leader = st.role == LEADER  # [N, S]
+    not_self = ~jnp.eye(S, dtype=bool)[None]
+    applies = not_self & (ct[:, None, :] <= ct[:, :, None])  # [N, l, p]
+    over = ci[:, None, :] > ll[:, :, None]
+    mism = (st.log_term[:, None, :, :] != st.log_term[:, :, None, :]) | (
+        st.log_val[:, None, :, :] != st.log_val[:, :, None, :]
+    )  # [N, l, p, L]
+    in_prefix = jnp.arange(L)[None, None, None, :] < ci[:, None, :, None]
+    differs = (mism & in_prefix).any(-1)
+    bad = applies & (over | differs)
+    ok_l = is_leader & ~bad.any(-1)
+    return ~is_leader.any(-1) | ok_l.any(-1)
+
+
+def raft_can_commt(cfg, st, tables=None):
+    """RaftCanCommt [sic] — Raft.tla:434."""
+    return (st.commit_index.astype(I32) > 1).any(-1)
+
+
+def follower_can_commit(cfg, st, tables=None):
+    """FollowerCanCommit — Raft.tla:436-439."""
+    return ((st.role == FOLLOWER) & (st.commit_index.astype(I32) > 1)).any(-1)
+
+
+def commit_all(cfg, st, tables=None):
+    """CommitAll — Raft.tla:442 (literal constant 3)."""
+    return (st.commit_index.astype(I32) == 3).all(-1)
+
+
+def no_split_vote(cfg, st, tables=None):
+    """NoSplitVote — Raft.tla:444-449: no two Leaders share a term."""
+    S = cfg.S
+    lead = st.role == LEADER
+    ct = st.current_term.astype(I32)
+    pair = (
+        lead[:, :, None]
+        & lead[:, None, :]
+        & (ct[:, :, None] == ct[:, None, :])
+        & ~jnp.eye(S, dtype=bool)[None]
+    )
+    return ~pair.any((-2, -1))
+
+
+def exist_leader_and_candidate(cfg, st, tables=None):
+    """ExistLeaderAndCandidate — Raft.tla:483-487."""
+    return (st.role == LEADER).any(-1) & (st.role == CANDIDATE).any(-1)
+
+
+def no_all_commit(cfg, st, tables):
+    """NoAllCommit — Raft.tla:451-481: a specific negative-scenario probe.
+
+    ∃ s1 # s2, s2 # s3 with a fixed role/commit/matchIndex configuration
+    plus three message-existence conditions; needs the GuardTables message
+    pattern masks for the two AppendReq existentials.
+    """
+    S = cfg.S
+    ct = st.current_term.astype(I32)
+    ci = st.commit_index.astype(I32)
+    mi = st.match_index.astype(I32)
+    role = st.role
+    N = role.shape[0]
+
+    hold = jnp.zeros((N,), bool)
+    for s1 in range(S):
+        for s2 in range(S):
+            if s2 == s1:
+                continue
+            for s3 in range(S):
+                if s3 == s2:  # spec only requires s1 # s2 /\ s2 # s3
+                    continue
+                base = (
+                    (role[:, s1] == LEADER)
+                    & (role[:, s2] == FOLLOWER)
+                    & (role[:, s3] == FOLLOWER)
+                    & (ct[:, s1] == ct[:, s3])
+                    & (ci[:, s1] == 2)
+                    & (ci[:, s2] == 2)
+                    & (ci[:, s3] == 1)
+                    & (mi[:, s1, s2] == 2)
+                    & (mi[:, s1, s3] == 2)
+                )
+                if s1 == s3:
+                    continue  # messages below need s1 -> s3 with s1 # s3
+                t3 = jnp.clip(ct[:, s3] - 1, 0, cfg.T - 1)
+                # AppendReq s1->s3 at term t3 with prevLogIndex = 1
+                m1_mask = tables.aq_block[s1, s3, t3, 0]  # [N, W]
+                m1 = ((st.msgs & m1_mask) != 0).any(-1) & (ct[:, s3] >= 1)
+                # AppendResp s3->s1 at t3, prevLogIndex 1, success
+                mid = tables.uni.encode_appendresp(
+                    s3 + 1, s1 + 1, jnp.clip(ct[:, s3], 1, cfg.T), 1, 1
+                ).astype(I32)
+                word = jnp.take_along_axis(st.msgs, (mid >> 5)[:, None], axis=-1)[:, 0]
+                m2 = ((word >> (mid & 31).astype(jnp.uint32)) & 1).astype(bool)
+                # AppendReq s1->s3 with prevLogIndex = 2, any term
+                if cfg.L >= 2:
+                    m3_mask = tables.aq_block[s1, s3, 0, 1]
+                    for t in range(1, cfg.T):  # bitwise union over terms
+                        m3_mask = m3_mask | tables.aq_block[s1, s3, t, 1]
+                    m3 = ((st.msgs & m3_mask) != 0).any(-1)
+                else:
+                    m3 = jnp.zeros((N,), bool)
+                hold = hold | (base & m1 & m2 & m3)
+    return hold
+
+
+INVARIANT_KERNELS = {
+    "Inv": leader_has_all_committed_entries,
+    "LeaderHasAllCommittedEntries": leader_has_all_committed_entries,
+    "RaftCanCommt": raft_can_commt,
+    "FollowerCanCommit": follower_can_commit,
+    "CommitAll": commit_all,
+    "NoSplitVote": no_split_vote,
+    "NoAllCommit": no_all_commit,
+    "ExistLeaderAndCandidate": exist_leader_and_candidate,
+}
+
+
+def resolve_invariant_kernel(name: str):
+    """Resolve an invariant name; leading ``~`` negates (probe workflow)."""
+    if name.startswith("~"):
+        inner = INVARIANT_KERNELS[name[1:]]
+        return lambda cfg, st, tables: ~inner(cfg, st, tables)
+    fn = INVARIANT_KERNELS[name]
+    return lambda cfg, st, tables: fn(cfg, st, tables)

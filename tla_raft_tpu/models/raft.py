@@ -78,8 +78,8 @@ def init_batch(cfg: RaftConfig, n: int = 1) -> RaftState:
 # ---------------------------------------------------------------------------
 
 
-def from_oracle(cfg: RaftConfig, states) -> RaftState:
-    """Encode a list of oracle OStates as a batched RaftState (numpy path)."""
+def encode_np(cfg: RaftConfig, states) -> dict:
+    """Encode a list of oracle OStates as a dict of numpy arrays."""
     uni = get_universe(cfg)
     S, L, V = cfg.S, cfg.L, cfg.V
     n = len(states)
@@ -117,7 +117,12 @@ def from_oracle(cfg: RaftConfig, states) -> RaftState:
         a["pending"][i] = st.pending_response
         a["val_sent"][i] = st.val_sent
         a["msgs"][i] = uni.msgs_to_mask(st.msgs)
-    return RaftState(**{k: jnp.asarray(v) for k, v in a.items()})
+    return a
+
+
+def from_oracle(cfg: RaftConfig, states) -> RaftState:
+    """Encode a list of oracle OStates as a batched RaftState."""
+    return RaftState(**{k: jnp.asarray(v) for k, v in encode_np(cfg, states).items()})
 
 
 def to_oracle(cfg: RaftConfig, state: RaftState) -> list:

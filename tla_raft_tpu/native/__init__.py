@@ -1,0 +1,127 @@
+"""Native runtime tier: lazy-built C++ components bound via ctypes.
+
+``HostFPStore`` wraps fpstore.cpp — the external-memory fingerprint store
+that takes over TLC's FPSet role (JVM heap + ``states/`` disk spill,
+/root/reference/myrun.sh:3, .gitignore:2) when a run's visited set
+outgrows device HBM.  The shared library is compiled on first use with the
+system toolchain and cached next to the source.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "fpstore.cpp")
+_SO = os.path.join(_DIR, "libfpstore.so")
+
+
+def build_native(force: bool = False) -> str:
+    """Compile fpstore.cpp -> libfpstore.so (cached by mtime)."""
+    if (
+        not force
+        and os.path.exists(_SO)
+        and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+    ):
+        return _SO
+    tmp = _SO + ".tmp"
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp],
+        check=True,
+        capture_output=True,
+    )
+    os.replace(tmp, _SO)
+    return _SO
+
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(build_native())
+        lib.fpstore_open.restype = ctypes.c_void_p
+        lib.fpstore_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.fpstore_count.restype = ctypes.c_uint64
+        lib.fpstore_count.argtypes = [ctypes.c_void_p]
+        lib.fpstore_num_runs.restype = ctypes.c_uint64
+        lib.fpstore_num_runs.argtypes = [ctypes.c_void_p]
+        lib.fpstore_contains.restype = None
+        lib.fpstore_contains.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.fpstore_insert.restype = ctypes.c_uint64
+        lib.fpstore_insert.argtypes = lib.fpstore_contains.argtypes
+        lib.fpstore_compact.restype = ctypes.c_int
+        lib.fpstore_compact.argtypes = [ctypes.c_void_p]
+        lib.fpstore_close.restype = None
+        lib.fpstore_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class HostFPStore:
+    """Sorted-run external-memory u64 set with batched insert/membership."""
+
+    def __init__(self, dirpath: str, mem_budget_entries: int = 0):
+        os.makedirs(dirpath, exist_ok=True)
+        self._lib = _load()
+        self._h = self._lib.fpstore_open(
+            dirpath.encode(), ctypes.c_uint64(mem_budget_entries)
+        )
+        if not self._h:
+            raise RuntimeError("fpstore_open failed")
+
+    def __len__(self) -> int:
+        return int(self._lib.fpstore_count(self._h))
+
+    @property
+    def num_runs(self) -> int:
+        return int(self._lib.fpstore_num_runs(self._h))
+
+    def _ptrs(self, fps: np.ndarray):
+        fps = np.ascontiguousarray(fps, np.uint64)
+        out = np.zeros(len(fps), np.uint8)
+        return (
+            fps,
+            out,
+            fps.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+
+    def contains(self, fps: np.ndarray) -> np.ndarray:
+        fps, out, p_in, p_out = self._ptrs(fps)
+        self._lib.fpstore_contains(self._h, p_in, len(fps), p_out)
+        return out.astype(bool)
+
+    def insert(self, fps: np.ndarray) -> np.ndarray:
+        """Insert a batch; returns the is-new mask (False = already seen,
+        including duplicates earlier in the same batch)."""
+        fps, out, p_in, p_out = self._ptrs(fps)
+        added = self._lib.fpstore_insert(self._h, p_in, len(fps), p_out)
+        if added == np.uint64(0xFFFFFFFFFFFFFFFF):
+            raise IOError("fpstore spill failed")
+        return out.astype(bool)
+
+    def compact(self) -> None:
+        if self._lib.fpstore_compact(self._h) != 0:
+            raise IOError("fpstore compact failed")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.fpstore_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

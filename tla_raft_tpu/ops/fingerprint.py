@@ -1,0 +1,361 @@
+"""Canonical state fingerprints as MXU matmuls.
+
+TLC fingerprints each state with a 64-bit hash of the ``VIEW`` projection
+(Raft.cfg:26 -> Raft.tla:38), canonicalized under ``SYMMETRY symmServers``
+(Raft.cfg:24 -> Raft.tla:21) by taking the minimum fingerprint over all
+|Servers|! server permutations.  This module re-derives that capability as
+a TPU-native computation:
+
+* The state is flattened to a small integer **feature vector** (the 8 view
+  variables, plus the 4 aux variables for the full-state channel;
+  ``votedFor`` is one-hot expanded because its *values* are server-valued
+  and permute with the symmetry group).
+* The hash is **multilinear**: ``h = sum_e feat[e] * C[e] (mod 2^32)`` with
+  random 32-bit coefficients — a classic universal hash family, so any two
+  distinct feature vectors collide with probability 2^-32 per channel
+  (2^-64 over the paired channels that form the u64 fingerprint).
+* Applying a server permutation to the state permutes feature *positions*
+  (the one-hot trick linearizes the votedFor value remap), so the permuted
+  hash is the same matmul against **permutation-folded coefficient
+  tables** — no per-permutation gather of the data, just extra columns.
+* The message set's contribution is a set-hash ``sum_{m in msgs} G[p][m]``
+  where ``G[p]`` is the coefficient table pre-composed with the message-ID
+  permutation (ops/msg_universe.py ``perm_table``).  For a frontier state
+  this is one ``bits @ G`` matmul; for a successor it is the parent's sum
+  plus the few added-message coefficients (messages are only ever *added*:
+  SendMsg/SendMultiMsgs are set union, Raft.tla:43-45).
+* Coefficients are decomposed into 4 signed-byte planes so the whole hash
+  runs as int8 matmuls with int32 accumulation (the MXU-native integer
+  path); the signed-byte reinterpretation is a fixed linear transform of
+  the coefficient table, so the result is still an exact multilinear hash,
+  and the numpy reference path below reproduces it bit-for-bit.
+
+Two fingerprint channels are produced per state:
+
+* ``fp_view``  — hash of the VIEW projection (dedup key, TLC semantics),
+* ``fp_full``  — hash of all 12 variables (aux included).  Used as the
+  deterministic tiebreak when several same-view successors are generated
+  in one BFS level: the representative kept for expansion is the one with
+  the minimal ``fp_full``.  TLC leaves this choice to thread timing; we
+  make it canonical so runs (and the Python oracle) are reproducible.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+# 64-bit fingerprints (the TLC FPSet analog) flow through sort/searchsorted/
+# all_to_all as single u64 lanes; enable x64 before any kernel is traced.
+# All kernel dtypes are explicit, so default-dtype widening does not apply.
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import RaftConfig
+from .msg_universe import MsgUniverse, get_universe
+
+_SEED = 0x7C3A_11E5
+FP_SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+class FeatureSpec:
+    """Flattening of the 12 state variables into one small-int vector.
+
+    Layout (all slices static per config): currentTerm[S], role[S],
+    logTerm[S*L], logVal[S*L], logLen[S], matchIndex[S*S], nextIndex[S*S],
+    commitIndex[S], votedFor one-hot [S*(S+1)]  — the VIEW prefix — then
+    electionCount[1], restartCount[1], pendingResponse[S*S], valSent[V].
+    """
+
+    def __init__(self, cfg: RaftConfig):
+        self.cfg = cfg
+        S, L, V = cfg.S, cfg.L, cfg.V
+        off = 0
+
+        def take(n: int) -> slice:
+            nonlocal off
+            sl = slice(off, off + n)
+            off += n
+            return sl
+
+        self.ct = take(S)
+        self.role = take(S)
+        self.lt = take(S * L)
+        self.lv = take(S * L)
+        self.ll = take(S)
+        self.mi = take(S * S)
+        self.ni = take(S * S)
+        self.ci = take(S)
+        self.vf_oh = take(S * (S + 1))
+        self.F_view = off
+        self.ec = take(1)
+        self.rc = take(1)
+        self.pend = take(S * S)
+        self.vs = take(V)
+        self.F = off
+
+    # -- extraction (jnp; works for any leading batch dims) ----------------
+
+    def features(self, st) -> jnp.ndarray:
+        """RaftState (arbitrary leading dims on each leaf) -> i8[..., F]."""
+        S, L, V = self.cfg.S, self.cfg.L, self.cfg.V
+        lead = st.voted_for.shape[:-1]
+        flat = lambda x, n: x.reshape(*lead, n).astype(jnp.int8)
+        oh = (st.voted_for[..., :, None] == jnp.arange(S + 1, dtype=st.voted_for.dtype)).astype(
+            jnp.int8
+        )
+        return jnp.concatenate(
+            [
+                flat(st.current_term, S),
+                flat(st.role, S),
+                flat(st.log_term, S * L),
+                flat(st.log_val, S * L),
+                flat(st.log_len, S),
+                flat(st.match_index, S * S),
+                flat(st.next_index, S * S),
+                flat(st.commit_index, S),
+                oh.reshape(*lead, S * (S + 1)),
+                flat(st.election_count[..., None], 1),
+                flat(st.restart_count[..., None], 1),
+                flat(st.pending, S * S),
+                flat(st.val_sent, V),
+            ],
+            axis=-1,
+        )
+
+    def features_np(self, arrs: dict) -> np.ndarray:
+        """numpy variant over a dict of per-field arrays (oracle bridge)."""
+        S, L, V = self.cfg.S, self.cfg.L, self.cfg.V
+        lead = arrs["voted_for"].shape[:-1]
+        flat = lambda x, n: np.asarray(x).reshape(*lead, n).astype(np.int64)
+        oh = (np.asarray(arrs["voted_for"])[..., :, None] == np.arange(S + 1)).astype(np.int64)
+        return np.concatenate(
+            [
+                flat(arrs["current_term"], S),
+                flat(arrs["role"], S),
+                flat(arrs["log_term"], S * L),
+                flat(arrs["log_val"], S * L),
+                flat(arrs["log_len"], S),
+                flat(arrs["match_index"], S * S),
+                flat(arrs["next_index"], S * S),
+                flat(arrs["commit_index"], S),
+                oh.reshape(*lead, S * (S + 1)),
+                flat(np.asarray(arrs["election_count"])[..., None], 1),
+                flat(np.asarray(arrs["restart_count"])[..., None], 1),
+                flat(arrs["pending"], S * S),
+                flat(arrs["val_sent"], V),
+            ],
+            axis=-1,
+        )
+
+    # -- symmetry: feature-position permutation ----------------------------
+
+    def perm_source_indices(self, p: tuple[int, ...]) -> np.ndarray:
+        """pi[d] = source feature index that lands at position d under perm p.
+
+        p maps server s -> p[s-1] (1-based images, Raft.tla:21).  Per-server
+        structures move to permuted slots; matrix fields permute both axes;
+        the votedFor one-hot columns permute through p as well (the one-hot
+        trick that keeps the value remap linear).
+        """
+        cfg = self.cfg
+        S, L, V = cfg.S, cfg.L, cfg.V
+        inv = np.empty(S, np.int64)  # inv[i] = 0-based preimage of server i+1
+        for s0 in range(S):
+            inv[p[s0] - 1] = s0
+        src = np.empty(self.F, np.int64)
+        ar = np.arange
+        for sl in (self.ct, self.role, self.ll, self.ci):
+            src[sl] = sl.start + inv
+        for sl in (self.lt, self.lv):
+            src[sl] = sl.start + (inv[:, None] * L + ar(L)[None, :]).ravel()
+        for sl in (self.mi, self.ni, self.pend):
+            src[sl] = sl.start + (inv[:, None] * S + inv[None, :]).ravel()
+        # target one-hot (i, w) <- source (inv[i], 0 if w==0 else inv[w-1]+1)
+        wmap = np.concatenate([[0], inv + 1])
+        src[self.vf_oh] = self.vf_oh.start + (inv[:, None] * (S + 1) + wmap[None, :]).ravel()
+        src[self.ec] = self.ec.start
+        src[self.rc] = self.rc.start
+        src[self.vs] = self.vs.start + ar(V)
+        return src
+
+
+def _u32_to_i8_planes(c: np.ndarray) -> np.ndarray:
+    """u32[..., n] -> i8[..., n, 4] signed byte planes (LSB first)."""
+    b = np.stack([(c >> (8 * k)) & 0xFF for k in range(4)], axis=-1)
+    return b.astype(np.uint8).astype(np.int8)
+
+
+def _combine_planes_u32(planes) -> "jnp.ndarray | np.ndarray":
+    """i32[..., 4] plane sums -> u32[...] hash (shared jnp/np semantics)."""
+    xp = jnp if isinstance(planes, jnp.ndarray) else np
+    h = planes[..., 0].astype(xp.uint32)
+    for k in range(1, 4):
+        h = h + (planes[..., k].astype(xp.uint32) << xp.uint32(8 * k))
+    return h
+
+
+def _effective_u32(c: np.ndarray) -> np.ndarray:
+    """The coefficient the signed-byte-plane matmul *actually* applies.
+
+    Reinterpreting each byte plane as int8 shifts coefficients by fixed
+    multiples of 256 per plane; the hash stays multilinear but with this
+    transformed table. Delta-gather paths must use the same effective
+    values to stay bit-compatible with the matmul path.
+    """
+    planes = _u32_to_i8_planes(c).astype(np.int64)
+    return _combine_planes_u32(planes)
+
+
+class Fingerprinter:
+    """Permutation-folded hash tables + the fingerprint kernels for one cfg.
+
+    Channels 0,1 -> fp_view (aux-variable coefficients zeroed, matching the
+    VIEW projection Raft.tla:38); channels 2,3 -> fp_full (all 12 vars).
+    When ``cfg.use_view`` is False the view channels still hash the full
+    vector (TLC without VIEW fingerprints the complete state).
+    """
+
+    N_CHAN = 4
+
+    def __init__(self, cfg: RaftConfig, seed: int = _SEED):
+        self.cfg = cfg
+        self.uni: MsgUniverse = get_universe(cfg)
+        self.spec = FeatureSpec(cfg)
+        F, M = self.spec.F, self.uni.M
+        self.perms = cfg.server_perms()
+        P = len(self.perms)
+        self.P = P
+
+        rng = np.random.default_rng(seed)
+        C = rng.integers(0, 1 << 32, size=(self.N_CHAN, F), dtype=np.uint32)
+        G = rng.integers(0, 1 << 32, size=(self.N_CHAN, M), dtype=np.uint32)
+        if cfg.use_view:
+            C[0:2, self.spec.F_view :] = 0  # aux vars excluded from view hash
+
+        # Fold every permutation into the coefficient tables.
+        Cp = np.empty((P, self.N_CHAN, F), np.uint32)
+        Gp = np.empty((P, self.N_CHAN, M), np.uint32)
+        pt = self.uni.perm_table  # int32[P, M]: message id under each perm
+        for pi, p in enumerate(self.perms):
+            pi_src = self.spec.perm_source_indices(p)
+            # h_p(v) = sum_d C[d] v[pi_src[d]] = sum_e Cp[e] v[e]
+            Cp[pi][:, pi_src] = C
+            Gp[pi] = G[:, pt[pi]]
+
+        # Device tables. Plane matmul layout: columns = (P, chan, byte).
+        self.C_planes = jnp.asarray(
+            _u32_to_i8_planes(Cp).transpose(2, 0, 1, 3).reshape(F, P * self.N_CHAN * 4)
+        )
+        self.G_planes = jnp.asarray(
+            _u32_to_i8_planes(Gp).transpose(2, 0, 1, 3).reshape(M, P * self.N_CHAN * 4)
+        )
+        # Delta-gather table: u32[M+1, P, chan], row M = zeros (padding id).
+        gp_eff = _effective_u32(Gp)
+        gp_rows = np.concatenate(
+            [gp_eff.transpose(2, 0, 1), np.zeros((1, P, self.N_CHAN), np.uint32)]
+        )
+        self.G_rows = jnp.asarray(gp_rows)
+        # Host copies for the numpy reference path.
+        self._Cp_np, self._Gp_np = Cp, Gp
+
+    # -- jnp kernels -------------------------------------------------------
+
+    def _plane_matmul(self, x_i8: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
+        if jax.default_backend() == "cpu":
+            # XLA:CPU miscompiles the fused int8-dot -> byte-combine ->
+            # reduce chain (invalid LLVM IR "add i32, i8"); an i32 dot is
+            # bit-identical and sidesteps it.  TPU keeps the int8 MXU path.
+            out = jnp.dot(x_i8.astype(jnp.int32), table.astype(jnp.int32))
+        else:
+            out = jnp.dot(x_i8, table, preferred_element_type=jnp.int32)
+        return _combine_planes_u32(out.reshape(*x_i8.shape[:-1], self.P, self.N_CHAN, 4))
+
+    def feat_hash(self, feats: jnp.ndarray) -> jnp.ndarray:
+        """i8[..., F] -> u32[..., P, chan]."""
+        return self._plane_matmul(feats, self.C_planes)
+
+    def unpack_bits(self, packed: jnp.ndarray) -> jnp.ndarray:
+        """u32[..., n_words] -> i8[..., M]."""
+        uni = self.uni
+        bits = (packed[..., :, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+        return bits.reshape(*packed.shape[:-1], uni.n_words * 32)[..., : uni.M].astype(jnp.int8)
+
+    def msg_hash(self, packed: jnp.ndarray) -> jnp.ndarray:
+        """packed u32[..., n_words] -> set-hash u32[..., P, chan]."""
+        return self._plane_matmul(self.unpack_bits(packed), self.G_planes)
+
+    def delta_hash(self, ids: jnp.ndarray, live: jnp.ndarray) -> jnp.ndarray:
+        """Added-message contribution: ids i32[..., A], live bool[..., A].
+
+        Dead slots (live=False) contribute zero — used both for -1 padding
+        and for re-sent messages already present in the parent set (set
+        union adds nothing; see FollowerAcceptEntry, Raft.tla:292-295).
+        """
+        safe = jnp.where(live, ids, self.uni.M)
+        g = self.G_rows[safe]  # [..., A, P, chan]
+        return g.sum(axis=-3, dtype=jnp.uint32)
+
+    @staticmethod
+    def finalize(h: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """u32[..., P, chan] -> (fp_view u64[...], fp_full u64[...]).
+
+        Each fingerprint is the minimum over the symmetry group of the
+        64-bit pair formed by its two hash channels — TLC's min-fingerprint
+        symmetry normalization re-expressed on the hash itself.
+        """
+        h64 = h.astype(jnp.uint64)
+        view = (h64[..., 0] << jnp.uint64(32)) | h64[..., 1]
+        full = (h64[..., 2] << jnp.uint64(32)) | h64[..., 3]
+        return view.min(axis=-1), full.min(axis=-1)
+
+    def state_fingerprints(self, st) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Full-state path: (fp_view u64[N], fp_full u64[N], msum u32[N,P,chan]).
+
+        ``msum`` (the message-set hash partial) is returned so successor
+        fingerprints can be computed incrementally from it.
+        """
+        feats = self.spec.features(st)
+        msum = self.msg_hash(st.msgs)
+        fp_view, fp_full = self.finalize(self.feat_hash(feats) + msum)
+        return fp_view, fp_full, msum
+
+    def child_fingerprints(
+        self, feats: jnp.ndarray, parent_msum: jnp.ndarray, ids: jnp.ndarray, live: jnp.ndarray
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Successor path: features are fresh, message hash is incremental.
+
+        feats i8[..., F]; parent_msum u32[..., P, chan] (broadcastable);
+        ids/live [..., A] added-message ids and liveness.
+        """
+        h = self.feat_hash(feats) + parent_msum + self.delta_hash(ids, live)
+        return self.finalize(h)
+
+    # -- numpy reference path (oracle bridge, tests) -----------------------
+
+    def fingerprints_np(self, arrs: dict, msgs_bits: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Bit-exact host-side reproduction of the device hash.
+
+        arrs: per-field numpy arrays (models/raft.py layout) with one
+        leading batch dim; msgs_bits: u8[N, M] unpacked message bitmask.
+        """
+        feats = self.spec.features_np(arrs)  # i64[N, F]
+        # sum_e feat[e] * Cp  with the same signed-byte-plane linearization.
+        cp = _u32_to_i8_planes(self._Cp_np).astype(np.int64)  # [P, chan, F, 4]
+        gp = _u32_to_i8_planes(self._Gp_np).astype(np.int64)
+        planes = np.einsum("nf,pcfk->npck", feats, cp) + np.einsum(
+            "nm,pcmk->npck", msgs_bits.astype(np.int64), gp
+        )
+        h = _combine_planes_u32(planes)  # u32[N, P, chan]
+        h64 = h.astype(np.uint64)
+        view = ((h64[..., 0] << np.uint64(32)) | h64[..., 1]).min(axis=-1)
+        full = ((h64[..., 2] << np.uint64(32)) | h64[..., 3]).min(axis=-1)
+        return view, full
+
+
+@functools.lru_cache(maxsize=8)
+def get_fingerprinter(cfg: RaftConfig) -> Fingerprinter:
+    return Fingerprinter(cfg)

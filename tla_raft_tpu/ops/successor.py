@@ -1,0 +1,546 @@
+"""The successor kernel: Raft's ``Next`` as a masked fan-out on TPU.
+
+TLC evaluates ``Next`` (Raft.tla:416-430) as a disjunction walk — every
+action x existential witness yields at most one successor (SURVEY.md §3.2).
+All witness spaces are statically bounded by the model constants, so the
+whole walk compiles to a fixed fan-out of K **slots** per state, each slot
+a (family, server, witness...) coordinate with a validity mask:
+
+  family  0 BecomeCandidate(s)            Raft.tla:107-130   W = S
+  family  1 UpdateTerm(s) branch (a)      Raft.tla:178-182   W = S*T
+  family  2 UpdateTerm(s) branch (b)      Raft.tla:183-188   W = S
+  family  3 ResponseVote(s, cand)         Raft.tla:132-155   W = S*S
+  family  4 BecomeLeader(s)               Raft.tla:157-173   W = S
+  family  5 ClientReq(s, v)               Raft.tla:233-240   W = S*V
+  family  6 LeaderAppendEntry(s, dst)     Raft.tla:242-269   W = S*S
+  family  7 FollowerAcceptEntry(s, src,   Raft.tla:275-300   W = S*S*L*E*L
+              pli, entry, leaderCommit)
+  family  8 FollowerRejectEntry(s, src,   Raft.tla:302-321   W = S*S*L
+              pli)
+  family  9 HandleAppendResp(s, src,      Raft.tla:374-396   W = S*S*L*2
+              pli, success)
+  family 10 LeaderCanCommit(s)            Raft.tla:398-407   W = S
+  family 11 Restart(s)                    Raft.tla:409-414   W = S
+
+Existentials over the message set collapse onto the slot grid: where the
+successor depends only on a few message fields (e.g. UpdateTerm only reads
+``m.term``), the slot enumerates those fields and the guard becomes "any
+message matching this pattern present" — a bitwise AND against a
+precomputed pattern mask over the message universe.  Each slot also
+reports its **multiplicity** (how many concrete message witnesses it
+stands for), so the engine reproduces TLC's states-generated count
+exactly.
+
+Each family is written as a *scalar* transition function on one state and
+one witness — a direct transcription of the spec's action, structured like
+oracle/explicit.py — then ``vmap``'d over the witness grid and the state
+batch.  Pass 1 (``expand``) returns per-slot validity, multiplicity and
+the child's canonical fingerprints (features hashed fresh, message-set
+hash incremental from the parent's).  Pass 2 (``materialize``) rebuilds
+the full successor state for the slots that survived global dedup, via
+``lax.switch`` over the family id.
+
+The split-brain ``Assert(role[s] # Leader)`` (Raft.tla:185) is evaluated
+in-kernel as a per-state abort flag, faithful to TLC aborting the run
+during successor generation (SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import (
+    APPEND_REQ,
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    VOTE_REQ,
+    VOTE_RESP,
+    RaftConfig,
+)
+from ..models.raft import RaftState
+from .fingerprint import Fingerprinter, get_fingerprinter
+from .msg_universe import get_universe
+
+I32 = jnp.int32
+U8 = jnp.uint8
+U32 = jnp.uint32
+
+
+class Expansion(NamedTuple):
+    """Pass-1 output for a batch of B parent states and K slots each."""
+
+    valid: jnp.ndarray  # bool[B, K]
+    mult: jnp.ndarray  # i32[B, K] — concrete witness count of the slot
+    fp_view: jnp.ndarray  # u64[B, K] (garbage where invalid)
+    fp_full: jnp.ndarray  # u64[B, K]
+    abort: jnp.ndarray  # bool[B] — split-brain Assert fired (Raft.tla:185)
+
+
+def _pack(uni, bits: np.ndarray) -> np.ndarray:
+    return uni.pack_bits(bits.astype(np.uint8))
+
+
+class GuardTables:
+    """Precomputed pattern masks over the message universe (numpy -> device).
+
+    Each table row is a packed u32[n_words] bitmask selecting the messages
+    that match a (type, src, dst, term, ...) pattern; guards evaluate as
+    ``msgs & row`` followed by any/popcount.  Index conventions: servers
+    and terms are offset to 0-based rows (term t -> row t-1).
+    """
+
+    def __init__(self, cfg: RaftConfig):
+        uni = get_universe(cfg)
+        self.uni = uni
+        S, T, L = cfg.S, cfg.T, cfg.L
+        u = uni
+
+        # any message to dst at term t  (UpdateTerm branch (a), Raft.tla:178)
+        self.any_to = jnp.asarray(u.dst_term_any_mask)  # [S, T, W]
+        # AppendReq to dst at term t    (UpdateTerm branch (b) + Assert)
+        self.aq_to = jnp.asarray(u.dst_term_appendreq_mask)  # [S, T, W]
+
+        # VoteResp to dst at term t     (BecomeLeader count, Raft.tla:160-164)
+        vp = np.zeros((S, T, u.n_words), np.uint32)
+        for d in range(1, S + 1):
+            for t in range(1, T + 1):
+                vp[d - 1, t - 1] = _pack(u, (u.typ == VOTE_RESP) & (u.dst == d) & (u.term == t))
+        self.vp_to = jnp.asarray(vp)
+
+        # Up-to-date VoteReq from cand c to dst d at term t, given the
+        # receiver's (lastLogTerm, lastLogIndex)  (Raft.tla:145-147):
+        # qualifies iff m.llt > myllt \/ (m.llt = myllt /\ m.lli >= mylli).
+        vq = np.zeros((S, S, T, T + 1, L, u.n_words), np.uint32)
+        base_vq = u.typ == VOTE_REQ
+        for c in range(1, S + 1):
+            for d in range(1, S + 1):
+                if c == d:
+                    continue
+                for t in range(1, T + 1):
+                    sel = base_vq & (u.src == c) & (u.dst == d) & (u.term == t)
+                    for myllt in range(T + 1):
+                        for mylli in range(1, L + 1):
+                            ok = (u.llt > myllt) | ((u.llt == myllt) & (u.lli >= mylli))
+                            vq[c - 1, d - 1, t - 1, myllt, mylli - 1] = _pack(u, sel & ok)
+        self.vq_uptodate = jnp.asarray(vq)
+
+        # AppendReq blocks by (src, dst, term, prevLogIndex): all plt/entry/lc
+        # (FollowerRejectEntry witness collapse, Raft.tla:304-308), plus the
+        # per-prevLogTerm sub-blocks used to subtract the LogMatch cases.
+        blk = np.zeros((S, S, T, L, u.n_words), np.uint32)
+        sub = np.zeros((S, S, T, L, T + 1, u.n_words), np.uint32)
+        base_aq = u.typ == APPEND_REQ
+        for c in range(1, S + 1):
+            for d in range(1, S + 1):
+                if c == d:
+                    continue
+                for t in range(1, T + 1):
+                    sel0 = base_aq & (u.src == c) & (u.dst == d) & (u.term == t)
+                    for pli in range(1, L + 1):
+                        sel = sel0 & (u.pli == pli)
+                        blk[c - 1, d - 1, t - 1, pli - 1] = _pack(u, sel)
+                        for plt in range(T + 1):
+                            sub[c - 1, d - 1, t - 1, pli - 1, plt] = _pack(u, sel & (u.plt == plt))
+        self.aq_block = jnp.asarray(blk)
+        self.aq_plt = jnp.asarray(sub)
+
+
+def _bit_get(msgs: jnp.ndarray, mid: jnp.ndarray) -> jnp.ndarray:
+    """Membership test: packed u32[W] words, message id -> bool."""
+    word = msgs[jnp.clip(mid, 0, None) >> 5]
+    return ((word >> (mid & 31).astype(U32)) & U32(1)).astype(jnp.bool_)
+
+
+def _any(msgs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    return jnp.any((msgs & mask) != 0)
+
+
+def _popcount(msgs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.population_count(msgs & mask).sum().astype(I32)
+
+
+class SuccessorKernel:
+    """Compiled fan-out for one RaftConfig (SURVEY.md §7.2 step 2)."""
+
+    def __init__(self, cfg: RaftConfig, fpr: Fingerprinter | None = None):
+        self.cfg = cfg
+        self.uni = get_universe(cfg)
+        self.fpr = fpr or get_fingerprinter(cfg)
+        self.tables = GuardTables(cfg)
+        S, T, L, V = cfg.S, cfg.T, cfg.L, cfg.V
+        E = self.uni.n_entry
+        self.A = max(S - 1, 1)  # max messages added by one action
+
+        def grid(*dims):
+            g = np.meshgrid(*[np.arange(d) for d in dims], indexing="ij")
+            return np.stack([x.ravel() for x in g], axis=1).astype(np.int32)
+
+        def pad5(c):
+            out = np.zeros((c.shape[0], 5), np.int32)
+            out[:, : c.shape[1]] = c
+            return out
+
+        # (name, scalar fn, witness coords [W, 5]); coord 0 is always s.
+        self.families = [
+            ("BecomeCandidate", self._become_candidate, pad5(grid(S))),
+            ("UpdateTerm", self._update_term_a, pad5(grid(S, T))),
+            ("UpdateTerm", self._update_term_b, pad5(grid(S))),
+            ("ResponseVote", self._response_vote, pad5(grid(S, S))),
+            ("BecomeLeader", self._become_leader, pad5(grid(S))),
+            ("ClientReq", self._client_req, pad5(grid(S, V))),
+            ("LeaderAppendEntry", self._leader_append, pad5(grid(S, S))),
+            ("FollowerAcceptEntry", self._follower_accept, pad5(grid(S, S, L, E, L))),
+            ("FollowerRejectEntry", self._follower_reject, pad5(grid(S, S, L))),
+            ("HandleAppendResp", self._handle_append_resp, pad5(grid(S, S, L, 2))),
+            ("LeaderCanCommit", self._leader_can_commit, pad5(grid(S))),
+            ("Restart", self._restart, pad5(grid(S))),
+        ]
+        self.slot_family = np.concatenate(
+            [np.full(c.shape[0], fi, np.int32) for fi, (_, _, c) in enumerate(self.families)]
+        )
+        self.slot_coords = np.concatenate([c for _, _, c in self.families])
+        self.K = int(self.slot_family.shape[0])
+        self._slot_family_dev = jnp.asarray(self.slot_family)
+        self._slot_coords_dev = jnp.asarray(self.slot_coords)
+
+        self.expand = jax.jit(self._expand)
+        self.materialize = jax.jit(self._materialize)
+
+    # -- scalar action transcriptions -------------------------------------
+    # Each takes (st: RaftState with no batch dim, c: i32[5]) and returns
+    #   (valid: bool, mult: i32, child_small: RaftState, added: i32[A],
+    #    abort: bool)
+    # child_small carries the parent's packed msgs untouched; added lists
+    # the message ids this action sends (-1 padding).  All index arithmetic
+    # is clamped so invalid slots still compute in-range garbage.
+
+    def _no_add(self):
+        return jnp.full((self.A,), -1, I32)
+
+    def _become_candidate(self, st: RaftState, c):
+        cfg, uni = self.cfg, self.uni
+        S, T = cfg.S, cfg.T
+        s = c[0]
+        ct = st.current_term.astype(I32)
+        role = st.role[s]
+        valid = (
+            (st.election_count.astype(I32) < cfg.max_election)
+            & ((role == FOLLOWER) | (role == CANDIDATE))
+        )
+        new_term = jnp.clip(ct[s] + 1, 1, T)
+        ll = st.log_len.astype(I32)[s]
+        llt = jnp.clip(st.log_term.astype(I32)[s, ll - 1], 0, T - 1)
+        peers0 = (s + 1 + jnp.arange(S - 1, dtype=I32)) % S if S > 1 else jnp.zeros((1,), I32)
+        ids = uni.encode_votereq(s + 1, peers0 + 1, new_term, ll, llt).astype(I32)
+        added = jnp.full((self.A,), -1, I32).at[: ids.shape[0]].set(ids)
+        child = st._replace(
+            current_term=st.current_term.at[s].set(new_term.astype(U8)),
+            role=st.role.at[s].set(U8(CANDIDATE)),
+            voted_for=st.voted_for.at[s].set((s + 1).astype(U8)),
+            election_count=st.election_count + U8(1),
+        )
+        return valid, I32(1), child, added, False
+
+    def _update_term_a(self, st: RaftState, c):
+        s, t = c[0], c[1] + 1  # term 1..T
+        cur = st.current_term.astype(I32)[s]
+        mask = self.tables.any_to[s, t - 1]
+        hit = _any(st.msgs, mask)
+        valid = (t > cur) & hit
+        child = st._replace(
+            role=st.role.at[s].set(U8(FOLLOWER)),
+            current_term=st.current_term.at[s].set(t.astype(U8)),
+            voted_for=st.voted_for.at[s].set(U8(0)),
+        )
+        return valid, _popcount(st.msgs, mask), child, self._no_add(), False
+
+    def _update_term_b(self, st: RaftState, c):
+        s = c[0]
+        cur = st.current_term.astype(I32)[s]
+        mask = self.tables.aq_to[s, jnp.clip(cur - 1, 0, None)]
+        has = (cur >= 1) & _any(st.msgs, mask)
+        role = st.role[s]
+        valid = has & (role == CANDIDATE)
+        abort = has & (role == LEADER)  # Assert "split brain", Raft.tla:185
+        child = st._replace(role=st.role.at[s].set(U8(FOLLOWER)))
+        return valid, _popcount(st.msgs, mask), child, self._no_add(), abort
+
+    def _response_vote(self, st: RaftState, c):
+        cfg, uni = self.cfg, self.uni
+        T = cfg.T
+        s, cand = c[0], c[1]
+        cur = st.current_term.astype(I32)[s]
+        ll = st.log_len.astype(I32)[s]
+        llt = jnp.clip(st.log_term.astype(I32)[s, ll - 1], 0, T)
+        qual = self.tables.vq_uptodate[cand, s, jnp.clip(cur - 1, 0, None), llt, ll - 1]
+        vf = st.voted_for.astype(I32)[s]
+        grant = uni.encode_voteresp(s + 1, cand + 1, jnp.clip(cur, 1, None)).astype(I32)
+        valid = (
+            (st.role[s] == FOLLOWER)
+            & (cur >= 1)
+            & (cand != s)
+            & ((vf == 0) | (vf == cand + 1))
+            & _any(st.msgs, qual)
+            & ~_bit_get(st.msgs, grant)
+        )
+        child = st._replace(voted_for=st.voted_for.at[s].set((cand + 1).astype(U8)))
+        added = self._no_add().at[0].set(grant)
+        return valid, _popcount(st.msgs, qual), child, added, False
+
+    def _become_leader(self, st: RaftState, c):
+        cfg = self.cfg
+        S = cfg.S
+        s = c[0]
+        cur = st.current_term.astype(I32)[s]
+        votes = _popcount(st.msgs, self.tables.vp_to[s, jnp.clip(cur - 1, 0, None)])
+        valid = (st.role[s] == CANDIDATE) & (votes + 1 >= cfg.majority)
+        ll = st.log_len[s]
+        ar = jnp.arange(S)
+        child = st._replace(
+            role=st.role.at[s].set(U8(LEADER)),
+            match_index=st.match_index.at[s].set(jnp.where(ar == s, ll, U8(1)).astype(U8)),
+            next_index=st.next_index.at[s].set(jnp.full((S,), 0, U8) + ll + U8(1)),
+            pending=st.pending.at[s].set(jnp.zeros((S,), U8)),
+        )
+        return valid, I32(1), child, self._no_add(), False
+
+    def _client_req(self, st: RaftState, c):
+        cfg = self.cfg
+        L = cfg.L
+        s, v = c[0], c[1]
+        ll = st.log_len.astype(I32)[s]
+        valid = (st.role[s] == LEADER) & (st.val_sent[v] == 0) & (ll < L)
+        w = jnp.clip(ll, 0, L - 1)  # append position (0-based TLA index ll+1)
+        child = st._replace(
+            val_sent=st.val_sent.at[v].set(U8(1)),  # := FALSE, Raft.tla:237
+            log_term=st.log_term.at[s, w].set(st.current_term[s]),
+            log_val=st.log_val.at[s, w].set((v + 1).astype(U8)),
+            log_len=st.log_len.at[s].set((ll + 1).astype(U8)),
+            match_index=st.match_index.at[s, s].set((ll + 1).astype(U8)),
+        )
+        return valid, I32(1), child, self._no_add(), False
+
+    def _leader_append(self, st: RaftState, c):
+        cfg, uni = self.cfg, self.uni
+        T, L = cfg.T, cfg.L
+        s, d = c[0], c[1]
+        ct = st.current_term.astype(I32)[s]
+        ni = st.next_index.astype(I32)[s, d]
+        ll = st.log_len.astype(I32)[s]
+        pli = jnp.clip(ni - 1, 1, L)
+        plt = jnp.clip(st.log_term.astype(I32)[s, jnp.clip(ni - 2, 0, L - 1)], 0, T)
+        has_entry = ni <= ll
+        epos = jnp.clip(ni - 1, 0, L - 1)
+        ecode = jnp.where(
+            has_entry,
+            self.uni.entry_code(
+                jnp.clip(st.log_term.astype(I32)[s, epos], 1, T),
+                jnp.clip(st.log_val.astype(I32)[s, epos], 1, cfg.V),
+            ),
+            0,
+        )
+        mid = uni.encode_appendreq(
+            s + 1, d + 1, jnp.clip(ct, 1, T), pli, plt, ecode,
+            st.commit_index.astype(I32)[s],
+        ).astype(I32)
+        valid = (
+            (st.role[s] == LEADER)
+            & (d != s)
+            & (ni <= ll + 1)
+            & (st.pending[s, d] == 0)
+            & ~_bit_get(st.msgs, mid)
+        )
+        child = st._replace(pending=st.pending.at[s, d].set(U8(1)))
+        return valid, I32(1), child, self._no_add().at[0].set(mid), False
+
+    def _follower_accept(self, st: RaftState, c):
+        cfg, uni = self.cfg, self.uni
+        T, L, V = cfg.T, cfg.L, cfg.V
+        s, src, pli, e, lc = c[0], c[1], c[2] + 1, c[3], c[4] + 1
+        cur = st.current_term.astype(I32)[s]
+        ll = st.log_len.astype(I32)[s]
+        lt = st.log_term.astype(I32)[s]
+        lv = st.log_val.astype(I32)[s]
+        plt = jnp.clip(lt[jnp.clip(pli - 1, 0, L - 1)], 0, T)
+        mid = uni.encode_appendreq(
+            src + 1, s + 1, jnp.clip(cur, 1, T), pli, plt, e, lc
+        ).astype(I32)
+        log_match = pli <= ll  # plt equals the log term by construction
+        valid = (
+            (st.role[s] == FOLLOWER) & (cur >= 1) & (src != s) & log_match
+            & _bit_get(st.msgs, mid)
+        )
+        el = (e > 0).astype(I32)
+        eterm = jnp.where(el == 1, (e - 1) // V + 1, 0)
+        eval_ = jnp.where(el == 1, (e - 1) % V + 1, 0)
+        new_len = pli + el
+        append_new = new_len > ll
+        pos = jnp.clip(pli, 0, L - 1)  # 0-based slot of the carried entry
+        conflict = (el == 1) & (pli < ll) & ((lt[pos] != eterm) | (lv[pos] != eval_))
+        updated = append_new | conflict
+        ar = jnp.arange(L, dtype=I32)
+        keep = ar < pli
+        at_entry = (ar == pos) & (el == 1)
+        new_lt = jnp.where(keep, st.log_term[s], U8(0))
+        new_lt = jnp.where(at_entry, eterm.astype(U8), new_lt)
+        new_lv = jnp.where(keep, st.log_val[s], U8(0))
+        new_lv = jnp.where(at_entry, eval_.astype(U8), new_lv)
+        child = st._replace(
+            log_term=st.log_term.at[s].set(jnp.where(updated, new_lt, st.log_term[s])),
+            log_val=st.log_val.at[s].set(jnp.where(updated, new_lv, st.log_val[s])),
+            log_len=st.log_len.at[s].set(
+                jnp.where(updated, new_len, ll).astype(U8)
+            ),
+            commit_index=st.commit_index.at[s].set(
+                jnp.maximum(
+                    st.commit_index.astype(I32)[s], jnp.minimum(lc, new_len)
+                ).astype(U8)
+            ),
+        )
+        resp = uni.encode_appendresp(
+            s + 1, src + 1, jnp.clip(cur, 1, T), jnp.clip(pli + el, 1, L), 1
+        ).astype(I32)
+        return valid, I32(1), child, self._no_add().at[0].set(resp), False
+
+    def _follower_reject(self, st: RaftState, c):
+        cfg, uni = self.cfg, self.uni
+        T, L = cfg.T, cfg.L
+        s, src, pli = c[0], c[1], c[2] + 1
+        cur = st.current_term.astype(I32)[s]
+        ll = st.log_len.astype(I32)[s]
+        tix = jnp.clip(cur - 1, 0, None)
+        block = self.tables.aq_block[src, s, tix, pli - 1]
+        match_plt = jnp.clip(st.log_term.astype(I32)[s, jnp.clip(pli - 1, 0, L - 1)], 0, T)
+        sub = self.tables.aq_plt[src, s, tix, pli - 1, match_plt]
+        qual = jnp.where(pli <= ll, block & ~sub, block)
+        rej = uni.encode_appendresp(
+            s + 1, src + 1, jnp.clip(cur, 1, T), pli, 0
+        ).astype(I32)
+        valid = (
+            (st.role[s] == FOLLOWER) & (cur >= 1) & (src != s)
+            & _any(st.msgs, qual) & ~_bit_get(st.msgs, rej)
+        )
+        return valid, _popcount(st.msgs, qual), st, self._no_add().at[0].set(rej), False
+
+    def _handle_append_resp(self, st: RaftState, c):
+        cfg, uni = self.cfg, self.uni
+        T = cfg.T
+        s, src, pli, sc = c[0], c[1], c[2] + 1, c[3]
+        cur = st.current_term.astype(I32)[s]
+        mid = uni.encode_appendresp(
+            src + 1, s + 1, jnp.clip(cur, 1, T), pli, sc
+        ).astype(I32)
+        mi = st.match_index.astype(I32)[s, src]
+        ni = st.next_index.astype(I32)[s, src]
+        base = (
+            (st.role[s] == LEADER) & (cur >= 1) & (src != s)
+            & (st.pending[s, src] == 1) & _bit_get(st.msgs, mid)
+        )
+        ok = jnp.where(sc == 1, mi < pli, (pli + 1 == ni) & (pli > mi))
+        valid = base & ok
+        child = st._replace(
+            match_index=st.match_index.at[s, src].set(
+                jnp.where(sc == 1, pli, mi).astype(U8)
+            ),
+            next_index=st.next_index.at[s, src].set((pli + sc).astype(U8)),
+            pending=st.pending.at[s, src].set(U8(0)),
+        )
+        return valid, I32(1), child, self._no_add(), False
+
+    def _leader_can_commit(self, st: RaftState, c):
+        cfg = self.cfg
+        s = c[0]
+        row = jnp.sort(st.match_index.astype(I32)[s])
+        med = row[cfg.majority - 1]  # Median(F), Raft.tla:70-75
+        valid = (st.role[s] == LEADER) & (med > st.commit_index.astype(I32)[s])
+        child = st._replace(commit_index=st.commit_index.at[s].set(med.astype(U8)))
+        return valid, I32(1), child, self._no_add(), False
+
+    def _restart(self, st: RaftState, c):
+        cfg = self.cfg
+        s = c[0]
+        valid = (st.role[s] == LEADER) & (
+            st.restart_count.astype(I32) < cfg.max_restart
+        )
+        child = st._replace(
+            role=st.role.at[s].set(U8(FOLLOWER)),
+            restart_count=st.restart_count + U8(1),
+        )
+        return valid, I32(1), child, self._no_add(), False
+
+    # -- pass 1: expand + fingerprint -------------------------------------
+
+    def _family_expand(self, fn, coords, st: RaftState, msum: jnp.ndarray):
+        """One family for one state: vmap over the witness grid."""
+
+        def one(cw):
+            valid, mult, child, added, abort = fn(st, cw)
+            feats = self.fpr.spec.features(child)
+            # Union semantics: a message already present contributes nothing
+            # (relevant for FollowerAcceptEntry's un-guarded response).
+            live = (added >= 0) & ~jax.vmap(lambda i: _bit_get(st.msgs, i))(added)
+            fv, ff = self.fpr.child_fingerprints(feats, msum, added, live)
+            return valid, mult, fv, ff, abort
+
+        return jax.vmap(one)(coords)
+
+    def _expand(self, st: RaftState, msum: jnp.ndarray) -> Expansion:
+        """Batched fan-out. st leaves have leading dim B; msum u32[B, P, C]."""
+
+        def per_state(st1, msum1):
+            outs = [
+                self._family_expand(fn, jnp.asarray(coords), st1, msum1)
+                for _, fn, coords in self.families
+            ]
+            valid = jnp.concatenate([o[0] for o in outs])
+            mult = jnp.concatenate([o[1] for o in outs])
+            fv = jnp.concatenate([o[2] for o in outs])
+            ff = jnp.concatenate([o[3] for o in outs])
+            abort = jnp.any(jnp.stack([jnp.any(o[4]) for o in outs]))
+            return valid, mult, fv, ff, abort
+
+        valid, mult, fv, ff, abort = jax.vmap(per_state)(st, msum)
+        return Expansion(valid, mult & jnp.where(valid, -1, 0), fv, ff, abort)
+
+    # -- pass 2: materialize surviving slots ------------------------------
+
+    def _materialize_one(self, st: RaftState, slot: jnp.ndarray) -> RaftState:
+        fam = self._slot_family_dev[slot]
+        coords = self._slot_coords_dev[slot]
+
+        def mk(fn):
+            def branch(args):
+                st1, cw = args
+                _valid, _mult, child, added, _abort = fn(st1, cw)
+                # set the added-message bits (SendMsg union, Raft.tla:43-45)
+                msgs = child.msgs
+
+                def set_bit(m, mid):
+                    live = mid >= 0
+                    w = jnp.clip(mid, 0, None) >> 5
+                    bit = jnp.where(live, U32(1) << (mid & 31).astype(U32), U32(0))
+                    return m.at[w].set(m[w] | bit)
+
+                for a in range(self.A):
+                    msgs = set_bit(msgs, added[a])
+                return child._replace(msgs=msgs)
+
+            return branch
+
+        branches = [mk(fn) for _, fn, _ in self.families]
+        return jax.lax.switch(fam, branches, (st, coords))
+
+    def _materialize(self, parents: RaftState, slots: jnp.ndarray) -> RaftState:
+        """parents: leaves with leading dim G (already gathered); slots i32[G]."""
+        return jax.vmap(self._materialize_one)(parents, slots)
+
+
+@functools.lru_cache(maxsize=8)
+def get_kernel(cfg: RaftConfig) -> SuccessorKernel:
+    return SuccessorKernel(cfg)

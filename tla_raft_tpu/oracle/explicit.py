@@ -31,6 +31,7 @@ Encoding conventions (shared with models/raft.py):
 from __future__ import annotations
 
 import collections
+import dataclasses
 from typing import Callable, Iterable, NamedTuple
 
 from ..config import (
@@ -680,31 +681,53 @@ class CheckResult(NamedTuple):
 
 
 class OracleChecker:
-    """Level-synchronous BFS with view+symmetry dedup — mirrors TLC."""
+    """Level-synchronous BFS with view+symmetry dedup — mirrors TLC.
+
+    Two deliberate refinements over TLC, both shared with the TPU engine
+    (engine/bfs.py) so the two implementations are bit-reproducible:
+
+    1. Representative choice.  When several successors generated in the
+       same level collapse to one view fingerprint but differ in the aux
+       variables (which still gate enabledness — SURVEY.md §5 "config
+       trap (a)"), TLC keeps whichever its worker threads insert first;
+       we keep the one with the **minimal canonical full-state
+       fingerprint** (the shared 64-bit hash from ops/fingerprint.py).
+       Candidates are first collapsed by symmetry-canonical full key, so
+       the tiebreak only arbitrates genuinely aux-distinct states.
+    2. Violation timing.  TLC stops interning mid-level when an invariant
+       trips, so its reported distinct/level counts depend on worker
+       timing; both our implementations finish interning the level, then
+       report — counts on violation runs are therefore deterministic and
+       include the full final level.
+    """
 
     def __init__(self, cfg: RaftConfig):
         self.cfg = cfg
         self.perms = cfg.server_perms()
         self.inv_fns = [(n, resolve_invariant(n)) for n in cfg.invariants]
+        self._fpr = None  # lazy: only needed when a view-group is ambiguous
+
+    def _full_fp(self, st: OState) -> int:
+        """The TPU engine's fp_full hash of one state (numpy path)."""
+        from ..models.raft import encode_np
+        from ..ops.fingerprint import get_fingerprinter
+        from ..ops.msg_universe import get_universe
+
+        if self._fpr is None:
+            self._fpr = get_fingerprinter(self.cfg)
+        arrs = encode_np(self.cfg, [st])
+        bits = get_universe(self.cfg).unpack_bits(arrs["msgs"])
+        _view, full = self._fpr.fingerprints_np(arrs, bits)
+        return int(full[0])
 
     def run(self, max_depth: int | None = None) -> CheckResult:
         cfg = self.cfg
         init = init_state(cfg)
-        seen: dict = {}
+        seen: set = set()
         states: list[OState] = []
         parents: list[tuple[int, str]] = []  # (parent_id, action) per state id
         level_sizes = []
         generated = 0
-
-        def intern(st: OState, parent: int, action: str) -> int | None:
-            key = canonical_key(cfg, st, self.perms)
-            if key in seen:
-                return None
-            sid = len(states)
-            seen[key] = sid
-            states.append(st)
-            parents.append((parent, action))
-            return sid
 
         def violation(kind: str, sid: int) -> CheckResult:
             trace = self._trace(states, parents, sid)
@@ -713,18 +736,21 @@ class OracleChecker:
                 tuple(level_sizes), (kind, trace),
             )
 
-        sid0 = intern(init, -1, "Init")
+        seen.add(canonical_key(cfg, init, self.perms))
+        states.append(init)
+        parents.append((-1, "Init"))
         for name, fn in self.inv_fns:
             if not fn(cfg, init):
                 level_sizes.append(1)
-                return violation(f"Invariant {name} is violated", sid0)
-        frontier = [sid0]
+                return violation(f"Invariant {name} is violated", 0)
+        frontier = [0]
         level_sizes.append(1)
         depth = 0
         while frontier:
             if max_depth is not None and depth >= max_depth:
                 break
-            next_frontier = []
+            # Phase 1: expand the whole level, collecting every successor.
+            groups: dict = {}  # view key -> list of (child, parent_sid, action)
             for sid in frontier:
                 st = states[sid]
                 try:
@@ -733,18 +759,44 @@ class OracleChecker:
                     return violation('Assert "split brain" (Raft.tla:185)', sid)
                 generated += len(succs)
                 for action, s, _detail, nxt in succs:
-                    nid = intern(nxt, sid, f"{action}({s})")
-                    if nid is None:
+                    key = canonical_key(cfg, nxt, self.perms)
+                    if key in seen:
                         continue
+                    groups.setdefault(key, []).append((nxt, sid, f"{action}({s})"))
+            # Phase 2: pick the canonical representative per new view key.
+            next_frontier = []
+            bad: int | None = None
+            bad_name = None
+            full_cfg = dataclasses.replace(cfg, use_view=False)
+            for key, cands in groups.items():
+                if len(cands) > 1:
+                    # collapse symmetry orbits first: symmetric images share
+                    # the canonical full fp, so only genuinely aux-distinct
+                    # candidates reach the hash tiebreak
+                    distinct = {}
+                    for c in cands:
+                        fk = canonical_key(full_cfg, c[0], self.perms)
+                        distinct.setdefault(fk, c)
+                    cands = list(distinct.values())
+                if len(cands) > 1:
+                    cands.sort(key=lambda c: self._full_fp(c[0]))
+                child, psid, action = cands[0]
+                seen.add(key)
+                sid = len(states)
+                states.append(child)
+                parents.append((psid, action))
+                next_frontier.append(sid)
+                if bad is None:
                     for name, fn in self.inv_fns:
-                        if not fn(cfg, nxt):
-                            level_sizes.append(len(next_frontier) + 1)
-                            return violation(f"Invariant {name} is violated", nid)
-                    next_frontier.append(nid)
-            frontier = next_frontier
-            if frontier:
-                level_sizes.append(len(frontier))
+                        if not fn(cfg, child):
+                            bad, bad_name = sid, name
+                            break
+            if next_frontier:
+                level_sizes.append(len(next_frontier))
                 depth += 1
+            if bad is not None:
+                return violation(f"Invariant {bad_name} is violated", bad)
+            frontier = next_frontier
         return CheckResult(
             True, len(states), generated, depth, tuple(level_sizes), None
         )

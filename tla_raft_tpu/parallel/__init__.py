@@ -1,0 +1,3 @@
+"""Distributed checking: mesh-sharded frontier + fingerprint exchange."""
+
+from .sharded import ShardedChecker, make_mesh  # noqa: F401
