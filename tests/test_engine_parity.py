@@ -36,6 +36,8 @@ def test_full_run_parity(cfg):
     assert got.generated == want.generated
     assert got.depth == want.depth
     assert got.level_sizes == want.level_sizes
+    # TLC -coverage analog: per-action fired-transition counts must agree
+    assert got.action_counts == want.action_counts
 
 
 def test_probe_violation_and_trace():

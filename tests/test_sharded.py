@@ -1,9 +1,10 @@
 """Distributed (mesh-sharded) checker vs the oracle on a virtual CPU mesh.
 
 The conftest forces 8 virtual CPU devices; the distributed level step must
-produce identical distinct/generated/depth/level-size numbers as the
-oracle for any device count — the fingerprint exchange and the
-deterministic representative rule make the result mesh-shape-invariant.
+produce identical distinct/generated/depth/level-size/coverage numbers as
+the oracle for any device count and either fingerprint-exchange strategy —
+the owner-sharded all_to_all routing (hash-sharded visited store) and the
+small-scale all_gather (replicated store).
 """
 
 import jax
@@ -19,17 +20,44 @@ CFGS = [
 ]
 
 
+@pytest.mark.parametrize("exchange", ["all_to_all", "all_gather"])
 @pytest.mark.parametrize("ndev", [2, 8])
 @pytest.mark.parametrize("cfg", CFGS, ids=["s2", "s3"])
-def test_sharded_parity(cfg, ndev):
+def test_sharded_parity(cfg, ndev, exchange):
     if len(jax.devices()) < ndev:
         pytest.skip("not enough virtual devices")
     want = OracleChecker(cfg).run()
     mesh = make_mesh(ndev)
-    got_distinct, got_generated, got_depth, got_levels = ShardedChecker(
-        cfg, mesh, cap_x=512
+    got = ShardedChecker(cfg, mesh, cap_x=512, vcap=4096, exchange=exchange).run()
+    assert got.ok == want.ok
+    assert got.distinct == want.distinct
+    assert got.generated == want.generated
+    assert got.depth == want.depth
+    assert got.level_sizes == want.level_sizes
+    assert got.action_counts == want.action_counts
+
+
+def test_sharded_vcap_growth():
+    """A deliberately tiny store shard must grow, not corrupt the run."""
+    cfg = CFGS[0]
+    want = OracleChecker(cfg).run()
+    got = ShardedChecker(
+        cfg, make_mesh(2), cap_x=512, vcap=16, exchange="all_to_all"
     ).run()
-    assert got_distinct == want.distinct
-    assert got_generated == want.generated
-    assert got_depth == want.depth
-    assert got_levels == want.level_sizes
+    assert (got.distinct, got.depth) == (want.distinct, want.depth)
+
+
+def test_sharded_violation_trace():
+    """Probe violations surface through the distributed path with a trace."""
+    cfg = RaftConfig(
+        n_servers=3, n_vals=1, max_election=1, max_restart=0,
+        invariants=("~RaftCanCommt",),
+    )
+    want = OracleChecker(cfg).run()
+    got = ShardedChecker(cfg, make_mesh(4), cap_x=512, vcap=4096).run()
+    assert not got.ok and not want.ok
+    assert got.depth == want.depth
+    kind, trace = got.violation
+    assert "RaftCanCommt" in kind
+    assert trace[0][0] == "Init"
+    assert any(ci > 1 for ci in trace[-1][1].commit_index)

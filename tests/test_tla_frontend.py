@@ -1,0 +1,55 @@
+"""Spec front-end: the reference Raft.tla must validate; mutations must not.
+
+This also implements SURVEY.md §4.4's planted-mutation workflow: the
+reference keeps buggy/legacy action variants in comments (FindMedian's
+off-by-one, the monolithic FollowerAppendEntry); a spec whose Next uses a
+different action set or whose VIEW/invariant bindings change must be
+rejected by the front-end rather than silently checked with the compiled
+(unmutated) semantics.
+"""
+
+import pytest
+
+from tla_raft_tpu.tla_frontend import (
+    EXPECTED_ACTIONS,
+    extract_skeleton,
+    validate_spec,
+)
+
+REF = "/root/reference/Raft.tla"
+
+
+def test_reference_spec_validates():
+    assert validate_spec(REF) == []
+
+
+def test_skeleton_extraction():
+    sk = extract_skeleton(open(REF).read())
+    assert sk.view == (
+        "votedFor", "currentTerm", "logs", "matchIndex", "nextIndex",
+        "commitIndex", "msgs", "role",
+    )
+    assert tuple(sk.next_actions) == EXPECTED_ACTIONS
+    assert sk.invariant_binding == "LeaderHasAllCommittedEntries"
+
+
+@pytest.mark.parametrize(
+    "mutation,needle",
+    [
+        # swap the live FollowerAcceptEntry for the dead monolithic variant
+        (lambda s: s.replace("\\/ FollowerAcceptEntry(s)", "\\/ FollowerAppendEntry(s)"), "Next disjuncts"),
+        # change the checked invariant binding
+        (lambda s: s.replace("Inv ==\n    /\\ LeaderHasAllCommittedEntries", "Inv ==\n    /\\ NoSplitVote"), "Inv binds"),
+        # drop msgs from the VIEW projection
+        (lambda s: s.replace("msgs, role>>", "role>>"), "VIEW projection"),
+    ],
+)
+def test_mutated_specs_rejected(tmp_path, mutation, needle):
+    src = open(REF).read()
+    mutated = mutation(src)
+    assert mutated != src, "mutation did not apply"
+    p = tmp_path / "Mutated.tla"
+    p.write_text(mutated)
+    problems = validate_spec(str(p))
+    assert problems, "mutated spec was accepted"
+    assert any(needle in pr for pr in problems)

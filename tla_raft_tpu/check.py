@@ -107,6 +107,9 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tla_raft_tpu.check")
     p.add_argument("--config", default="/root/reference/Raft.cfg",
                    help="TLC .cfg file (single source of truth for constants)")
+    p.add_argument("--spec", default=None,
+                   help="TLA+ spec file to validate against the compiled "
+                        "semantics (default: Raft.tla next to the cfg)")
     p.add_argument("--backend", choices=("jax", "oracle"), default="jax")
     p.add_argument("--workers", type=int, default=None,
                    help="accepted for myrun.sh compatibility; ignored")
@@ -123,7 +126,15 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=1)
     p.add_argument("--recover", default=None, help="resume from a checkpoint .npz")
+    p.add_argument("--mesh", type=int, default=0,
+                   help="run distributed over an N-device mesh (0 = single device)")
+    p.add_argument("--exchange", choices=("all_to_all", "all_gather"),
+                   default="all_to_all", help="distributed fingerprint exchange")
+    p.add_argument("--cap-x", type=int, default=4096,
+                   help="per-device candidate capacity (distributed mode)")
     p.add_argument("--log", default="raft.log")
+    p.add_argument("--coverage", action="store_true",
+                   help="print per-action fired-transition counts (TLC -coverage)")
     p.add_argument("--json", action="store_true", help="emit a final JSON summary line")
     args = p.parse_args(argv)
 
@@ -151,6 +162,24 @@ def main(argv=None) -> int:
     t0 = time.monotonic()
     print(f"tla-raft-tpu checker: backend={args.backend}", file=out)
     print(f"Config {args.config}: {cfg.describe()}", file=out)
+
+    # structural spec validation (SURVEY.md §7.2 step 1): the compiled
+    # semantics must match the spec file actually sitting next to the cfg
+    spec_path = args.spec
+    if spec_path is None:
+        cand = os.path.join(os.path.dirname(os.path.abspath(args.config)), "Raft.tla")
+        spec_path = cand if os.path.exists(cand) else None
+    if spec_path:
+        from .tla_frontend import validate_spec
+
+        problems = validate_spec(spec_path)
+        if problems:
+            for pr in problems:
+                print(f"SPEC MISMATCH: {pr}", file=out)
+            print("Refusing to check a spec that diverges from the compiled "
+                  "semantics (pass --spec '' to skip).", file=out)
+            return 2
+        print(f"Spec {spec_path}: structure matches compiled semantics.", file=out)
 
     if args.backend == "oracle":
         from .oracle import OracleChecker
@@ -181,12 +210,20 @@ def main(argv=None) -> int:
             )
             out.flush()
 
-        res = JaxChecker(cfg, chunk=args.chunk, progress=progress).run(
-            max_depth=args.max_depth,
-            checkpoint_dir=args.checkpoint_dir,
-            checkpoint_every=args.checkpoint_every,
-            resume_from=args.recover,
-        )
+        if args.mesh:
+            from .parallel import ShardedChecker, make_mesh
+
+            res = ShardedChecker(
+                cfg, make_mesh(args.mesh), cap_x=args.cap_x,
+                exchange=args.exchange, progress=progress,
+            ).run(max_depth=args.max_depth)
+        else:
+            res = JaxChecker(cfg, chunk=args.chunk, progress=progress).run(
+                max_depth=args.max_depth,
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every,
+                resume_from=args.recover,
+            )
 
     dt = time.monotonic() - t0
     print(file=out)
@@ -201,6 +238,10 @@ def main(argv=None) -> int:
         f"found, depth {res.depth}.",
         file=out,
     )
+    if args.coverage and res.action_counts:
+        print("Action coverage (transitions fired):", file=out)
+        for name, n in sorted(res.action_counts.items(), key=lambda kv: -kv[1]):
+            print(f"  {name}: {n}", file=out)
     print(f"Finished in {dt:.1f}s ({res.distinct / max(dt, 1e-9):,.0f} distinct states/s).", file=out)
     if args.json:
         print(

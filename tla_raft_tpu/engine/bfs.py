@@ -58,6 +58,7 @@ class CheckResult(NamedTuple):
     depth: int
     level_sizes: tuple[int, ...]
     violation: tuple | None  # (kind, trace=[(action, OState), ...])
+    action_counts: dict | None = None  # TLC -coverage analog (see oracle)
 
 
 def _pow2(n: int) -> int:
@@ -153,6 +154,15 @@ class JaxChecker:
         msum = self.fpr.msg_hash(children.msgs)
         return children, msum
 
+    def _action_counts(self, mult_per_slot: np.ndarray) -> dict:
+        """Fold per-slot fired-transition counts to action names (the TLC
+        -coverage analog; UpdateTerm's two slot families sum together)."""
+        out: dict[str, int] = {}
+        fam = self.kern.slot_family
+        for fi, (name, _fn, _c) in enumerate(self.kern.families):
+            out[name] = out.get(name, 0) + int(mult_per_slot[fam == fi].sum())
+        return {k: v for k, v in out.items() if v}
+
     def _check_invariants(self, children: RaftState, n_valid: int):
         """Returns (all_ok, first_bad_index, bad_name) on the host."""
         N = children.voted_for.shape[0]
@@ -193,7 +203,8 @@ class JaxChecker:
     # -- checkpoint / resume (TLC's states/ metadir + -recover) ------------
 
     def _save_checkpoint(self, path, frontier, msum, visited, n_f, distinct,
-                         generated, depth, level_sizes, trace_levels):
+                         generated, depth, level_sizes, trace_levels,
+                         mult_per_slot):
         arrs = {f"st_{k}": np.asarray(v) for k, v in frontier._asdict().items()}
         for i, (p, s) in enumerate(trace_levels):
             arrs[f"trace_p{i}"] = p
@@ -203,6 +214,7 @@ class JaxChecker:
             tmp,
             msum=np.asarray(msum),
             visited=np.asarray(visited),
+            mult_per_slot=mult_per_slot,
             meta=np.asarray([n_f, distinct, generated, depth], np.int64),
             level_sizes=np.asarray(level_sizes, np.int64),
             n_trace=np.asarray([len(trace_levels)], np.int64),
@@ -223,6 +235,7 @@ class JaxChecker:
         return dict(
             frontier=frontier,
             msum=jnp.asarray(z["msum"]),
+            mult_per_slot=np.asarray(z["mult_per_slot"]),
             visited=jnp.asarray(z["visited"]),
             n_f=n_f,
             distinct=distinct,
@@ -252,6 +265,7 @@ class JaxChecker:
             depth, level_sizes, trace_levels = (
                 ck["depth"], ck["level_sizes"], ck["trace_levels"],
             )
+            mult_per_slot = ck["mult_per_slot"]
         else:
             frontier = init_batch(cfg, 1)
             n_f = 1
@@ -268,6 +282,7 @@ class JaxChecker:
             level_sizes = [1]
             depth = 0
             trace_levels = []
+            mult_per_slot = np.zeros(K, np.int64)
 
             ok, bad_idx, bad_name = self._check_invariants(frontier, 1)
             if not ok:
@@ -296,7 +311,7 @@ class JaxChecker:
                 fulls.append(jnp.where(valid, exp.fp_full, SENT).ravel())
                 base = (jnp.arange(start, stop, dtype=I64) * K)[:, None]
                 payloads.append((base + jnp.arange(K, dtype=I64)[None]).ravel())
-                mults.append(jnp.where(valid, exp.mult, 0).astype(I64).sum())
+                mults.append(jnp.where(valid, exp.mult, 0).astype(I64).sum(0))
                 ab = np.asarray(exp.abort & in_range[:, 0])
                 if ab.any():
                     abort_at = start + int(np.nonzero(ab)[0][0])
@@ -308,11 +323,14 @@ class JaxChecker:
                         'Assert "split brain" (Raft.tla:185)',
                         self._trace(trace_levels, depth, abort_at),
                     ),
+                    self._action_counts(mult_per_slot),
                 )
             fps_view = jnp.concatenate(views)
             fps_full = jnp.concatenate(fulls)
             payload = jnp.concatenate(payloads)
-            generated += int(sum(int(m) for m in mults))
+            level_mult = np.sum([np.asarray(m) for m in mults], axis=0)  # [K]
+            mult_per_slot += level_mult
+            generated += int(level_mult.sum())
 
             # --- dedup against visited + within level -------------------
             n_new_dev, new_fps, new_payload = _dedup(fps_view, fps_full, payload, visited)
@@ -366,7 +384,7 @@ class JaxChecker:
                 self._save_checkpoint(
                     os.path.join(checkpoint_dir, "latest.npz"), frontier, msum,
                     visited, n_f, distinct, generated, depth, level_sizes,
-                    trace_levels,
+                    trace_levels, mult_per_slot,
                 )
             if not ok:
                 return CheckResult(
@@ -375,8 +393,10 @@ class JaxChecker:
                         f"Invariant {bad_name} is violated",
                         self._trace(trace_levels, depth, bad_idx),
                     ),
+                    self._action_counts(mult_per_slot),
                 )
 
         return CheckResult(
-            True, distinct, generated, depth, tuple(level_sizes), None
+            True, distinct, generated, depth, tuple(level_sizes), None,
+            self._action_counts(mult_per_slot),
         )

@@ -678,6 +678,9 @@ class CheckResult(NamedTuple):
     depth: int  # max BFS level reached (init = level 0)
     level_sizes: tuple[int, ...]
     violation: tuple | None  # (kind, trace) where trace = [(action, state), ...]
+    action_counts: dict | None = None  # action name -> transitions fired
+    # (the TLC -coverage analog: how many concrete action x witness
+    # transitions were evaluated, duplicates included)
 
 
 class OracleChecker:
@@ -728,6 +731,7 @@ class OracleChecker:
         parents: list[tuple[int, str]] = []  # (parent_id, action) per state id
         level_sizes = []
         generated = 0
+        action_counts = collections.Counter()
 
         def violation(kind: str, sid: int) -> CheckResult:
             trace = self._trace(states, parents, sid)
@@ -758,6 +762,8 @@ class OracleChecker:
                 except SplitBrainAbort:
                     return violation('Assert "split brain" (Raft.tla:185)', sid)
                 generated += len(succs)
+                for action, _s, _d, _nxt in succs:
+                    action_counts[action] += 1
                 for action, s, _detail, nxt in succs:
                     key = canonical_key(cfg, nxt, self.perms)
                     if key in seen:
@@ -798,7 +804,8 @@ class OracleChecker:
                 return violation(f"Invariant {bad_name} is violated", bad)
             frontier = next_frontier
         return CheckResult(
-            True, len(states), generated, depth, tuple(level_sizes), None
+            True, len(states), generated, depth, tuple(level_sizes), None,
+            dict(action_counts),
         )
 
     @staticmethod

@@ -5,32 +5,38 @@ The reference's only parallelism is TLC's shared-memory worker pool
 unused.  The TPU-native replacement shards the **frontier** over a 1-D
 device mesh axis ``d`` (each device expands and materializes its own
 states — full states never cross the interconnect) and exchanges only
-64-bit fingerprints per BFS level:
+64-bit fingerprints per BFS level.  Two exchange strategies:
 
-  v1 (this module): each device locally pre-dedups its candidate
-  fingerprints (lexsort + unique), then an ``all_gather`` shares the
-  compacted per-device survivors; every device runs the same global
-  dedup against the (replicated) visited store and keeps exactly the
-  winners it originated.  Deterministic representative choice — min
-  (fp_view, fp_full, payload) — is preserved across any device count.
+* ``all_gather`` (small scale): each device locally pre-dedups its
+  candidate fingerprints (lexsort + unique), an ``all_gather`` shares the
+  compacted survivors, and every device runs the same global dedup
+  against a **replicated** visited store, keeping the winners it
+  originated.
 
-  v2 (planned, BASELINE.json north star): hash-shard the visited store
-  by ``fp mod n_dev`` and route candidates to owners with an
-  ``all_to_all``, returning verdict bits; drops the replicated store and
-  the redundant global dedup.
+* ``all_to_all`` (the scaling design, BASELINE.json north star): the
+  visited store is **hash-sharded** — device ``o`` owns fingerprint
+  ``fp`` iff ``fp % D == o``.  Each device routes its pre-deduped
+  candidates to their owners with one ``lax.all_to_all``, owners dedup
+  against their store shard (every copy of a fingerprint reaches the
+  same owner, so dedup is exact), update the shard in place, and return
+  one verdict bit per candidate with a reverse ``all_to_all``.  Nothing
+  is replicated; per-level interconnect traffic is ~16 bytes per
+  candidate fingerprint.
 
-New states are rebalanced across devices round-robin by global rank so
-frontier load stays even regardless of which device discovered them
-(states are cheap to ship *as (parent, slot) recipes*: the origin device
-holds the parent, so materialization happens on the origin and the
-balanced assignment only relabels which device expands the child — we
-implement this by keeping children on their origin device; hash
-uniformity keeps origination itself balanced).
+Determinism: representative choice is min (fp_view, fp_full, payload)
+under a global total order, so results are identical for any device
+count and to the single-device engine (engine/bfs.py) and the Python
+oracle — the parity tests assert exactly that.
+
+Invariant checking runs on each device over its freshly materialized
+children; counterexample traces replay the (slot) chain from Init just
+like the single-device engine.
 """
 
 from __future__ import annotations
 
 import functools
+import time
 from typing import NamedTuple
 
 import jax
@@ -39,11 +45,13 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import RaftConfig
-from ..models.raft import RaftState, init_batch
+from ..engine.invariants import resolve_invariant_kernel
+from ..models.raft import RaftState, init_batch, to_oracle
 from ..ops.successor import get_kernel
 
 U64 = jnp.uint64
 I64 = jnp.int64
+I32 = jnp.int32
 SENT = jnp.uint64(0xFFFFFFFFFFFFFFFF)
 
 
@@ -59,42 +67,76 @@ class LevelOut(NamedTuple):
 
     children: RaftState  # [cap_c, ...] local new states (padded)
     child_msum: jnp.ndarray  # u32[cap_c, P, chan]
-    n_new_local: jnp.ndarray  # i64[] this device's new states
+    visited: jnp.ndarray  # u64[vcap] updated store shard (all_to_all mode)
+    n_new_local: jnp.ndarray  # i64[1] this device's new states
     n_new_total: jnp.ndarray  # i64[] psum over mesh
     generated: jnp.ndarray  # i64[] psum over mesh
-    new_fps_global: jnp.ndarray  # u64[D*cap_x] all new fps (replicated)
-    pidx: jnp.ndarray  # i64[cap_c] local parent indices (for traces)
+    mult_slots: jnp.ndarray  # i64[K] psum'd per-slot fired counts
+    gpidx: jnp.ndarray  # i64[cap_c] global parent index (dev*cap_f+i)
     slots: jnp.ndarray  # i64[cap_c] local slots (for traces)
+    inv_bad: jnp.ndarray  # i32[] psum'd violation count this level
+    inv_bad_at: jnp.ndarray  # i64[1] local index of first violation or -1
     abort: jnp.ndarray  # bool[] any split-brain abort (psum'd)
-    overflow: jnp.ndarray  # bool[] cap_x exceeded somewhere -> retry bigger
+    overflow: jnp.ndarray  # bool[] a capacity was exceeded -> retry bigger
+
+
+class CheckResult(NamedTuple):
+    ok: bool
+    distinct: int
+    generated: int
+    depth: int
+    level_sizes: tuple[int, ...]
+    violation: tuple | None
+    action_counts: dict | None = None
+
+
+def _compact(mask, take_n, *arrays, fills):
+    """Stable-compact ``arrays`` rows where ``mask`` into ``take_n`` lanes."""
+    comp = jnp.argsort(~mask, stable=True)
+    take = jnp.arange(take_n)
+    src = comp[jnp.clip(take, 0, comp.shape[0] - 1)]
+    lane = (take < mask.sum()) & (take < comp.shape[0])
+    return tuple(
+        jnp.where(lane, a[src], fill) for a, fill in zip(arrays, fills)
+    ) + (lane,)
 
 
 class ShardedChecker:
-    """One distributed BFS level step, shard_map'd over a 1-D mesh.
+    """Distributed model checker over a 1-D device mesh.
 
-    The host driver (engine/bfs.py's loop generalizes; here we expose the
-    level step + a minimal ``run`` used by tests and the multichip
-    dry-run) keeps per-device frontier shards as a leading ``[D, cap_f]``
-    axis sharded over ``d``.
+    Parameters:
+      cap_x: per-device compacted-candidate capacity per level.
+      vcap:  per-device visited-shard capacity (all_to_all mode; grows on
+             demand by the host driver).
+      exchange: "all_to_all" (sharded store) or "all_gather" (replicated).
     """
 
-    def __init__(self, cfg: RaftConfig, mesh: Mesh, cap_x: int = 4096):
+    def __init__(
+        self,
+        cfg: RaftConfig,
+        mesh: Mesh,
+        cap_x: int = 4096,
+        vcap: int = 1 << 16,
+        exchange: str = "all_to_all",
+        progress=None,
+    ):
+        assert exchange in ("all_to_all", "all_gather")
         self.cfg = cfg
         self.mesh = mesh
         self.kern = get_kernel(cfg)
         self.fpr = self.kern.fpr
         self.K = self.kern.K
         self.D = mesh.devices.size
-        self.cap_x = cap_x  # per-device compacted-candidate capacity
+        self.cap_x = cap_x
+        self.vcap = vcap
+        self.exchange = exchange
+        self.progress = progress
+        self.inv_fns = [(n, resolve_invariant_kernel(n)) for n in cfg.invariants]
 
     # -- the per-device level body ----------------------------------------
 
-    def _level_body(self, frontier: RaftState, msum, n_f, visited):
-        """Runs per device under shard_map; arrays are local shards.
-
-        frontier leaves: [cap_f_local, ...]; n_f: i64[1] local live count;
-        visited: u64[Vcap] replicated sorted store.
-        """
+    def _expand_local(self, frontier, msum, n_f):
+        """Expand + local pre-dedup; returns compacted candidates."""
         K = self.K
         cap_f = frontier.voted_for.shape[0]
         dev = jax.lax.axis_index("d").astype(I64)
@@ -104,88 +146,176 @@ class ShardedChecker:
         valid = exp.valid & in_range
         fpv = jnp.where(valid, exp.fp_view, SENT).ravel()
         fpf = jnp.where(valid, exp.fp_full, SENT).ravel()
-        # global payload: (device-global parent index) * K + slot
         gparent = dev * cap_f + jnp.arange(cap_f, dtype=I64)
         payload = (gparent[:, None] * K + jnp.arange(K, dtype=I64)[None]).ravel()
-        generated = jax.lax.psum(
-            jnp.where(valid, exp.mult, 0).astype(I64).sum(), "d"
+        mult_slots = jax.lax.psum(
+            jnp.where(valid, exp.mult, 0).astype(I64).sum(0), "d"
         )
-        abort = jax.lax.psum(
-            (exp.abort & in_range[:, 0]).any().astype(jnp.int32), "d"
-        ) > 0
+        abort = (
+            jax.lax.psum((exp.abort & in_range[:, 0]).any().astype(I32), "d") > 0
+        )
 
-        # local pre-dedup: first (min fp_full, min payload) per view fp
+        # local pre-dedup: min (fp_full, payload) representative per view fp
         order = jnp.lexsort((payload, fpf, fpv))
         sv, sf, sp = fpv[order], fpf[order], payload[order]
         first = jnp.concatenate([jnp.ones((1,), bool), sv[1:] != sv[:-1]])
-        pos = jnp.searchsorted(visited, sv)
-        hit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == sv
-        keep = first & (sv != SENT) & ~hit
-        n_keep = keep.sum()
-        overflow = n_keep > self.cap_x
-        comp = jnp.argsort(~keep, stable=True)
-        take = jnp.arange(self.cap_x)
-        src = comp[jnp.clip(take, 0, comp.shape[0] - 1)]
-        lane = (take < n_keep) & (take < comp.shape[0])
-        cv = jnp.where(lane, sv[src], SENT)
-        cf = jnp.where(lane, sf[src], SENT)
-        cp = jnp.where(lane, sp[src], -1)
+        keep = first & (sv != SENT)
+        overflow = keep.sum() > self.cap_x
+        cv, cf, cp, _lane = _compact(
+            keep, self.cap_x, sv, sf, sp, fills=(SENT, SENT, I64(-1))
+        )
+        return cv, cf, cp, mult_slots, abort, overflow, dev, cap_f
 
-        # exchange compacted candidates; global dedup replicated on every
-        # device (identical inputs -> identical result, no divergence)
-        gv = jax.lax.all_gather(cv, "d").reshape(-1)
-        gf = jax.lax.all_gather(cf, "d").reshape(-1)
-        gp = jax.lax.all_gather(cp, "d").reshape(-1)
-        gorder = jnp.lexsort((gp, gf, gv))
-        gsv = gv[gorder]
-        gfirst = jnp.concatenate([jnp.ones((1,), bool), gsv[1:] != gsv[:-1]])
-        gnew = gfirst & (gsv != SENT)
-        n_new_total = gnew.sum().astype(I64)
-        # each device keeps the winners whose parent lives on it
-        gpay = gp[gorder]
-        win = gnew & (gpay // (K * cap_f) == dev)
-        n_new_local = win.sum().astype(I64)
-        cap_c = self.cap_x  # local children capacity
-        wcomp_full = jnp.argsort(~win, stable=True)
-        wtake = jnp.arange(cap_c)
-        wcomp = wcomp_full[jnp.clip(wtake, 0, wcomp_full.shape[0] - 1)]
-        wlane = (wtake < n_new_local) & (wtake < wcomp_full.shape[0])
-        wpay = jnp.where(wlane, gpay[wcomp], 0)
+    def _children_from(self, frontier, cap_f, dev, wpay, wlane):
+        """Materialize chosen (payload) slots locally + invariants."""
+        K = self.K
         pidx = (wpay // K) % cap_f
         slots = wpay % K
         parents = jax.tree.map(lambda x: x[pidx], frontier)
         children = self.kern.materialize(parents, slots)
         child_msum = self.fpr.msg_hash(children.msgs)
-        # mask padding lanes to the (deterministic) init-like zero state so
-        # replicated buffers stay bitwise equal across devices
         children = jax.tree.map(
             lambda x: jnp.where(
                 wlane.reshape((-1,) + (1,) * (x.ndim - 1)), x, jnp.zeros_like(x)
             ),
             children,
         )
-        new_fps = jnp.where(gnew, gsv, SENT)
-        gcomp = jnp.argsort(~gnew, stable=True)
-        new_fps = new_fps[gcomp]  # compacted, SENT-padded, replicated
+        # invariants on the fresh level shard
+        bad_local = jnp.zeros(children.voted_for.shape[0], bool)
+        for _name, fn in self.inv_fns:
+            bad_local = bad_local | (~fn(self.cfg, children, self.kern.tables) & wlane)
+        inv_bad = jax.lax.psum(bad_local.sum().astype(I32), "d")
+        has_bad = bad_local.any()
+        first_bad = jnp.where(has_bad, jnp.argmax(bad_local), -1).astype(I64)
+        gpidx = jnp.where(wlane, dev * cap_f + pidx, -1)
+        return children, child_msum, gpidx, slots, inv_bad, first_bad
 
+    def _body_all_gather(self, frontier, msum, n_f, visited):
+        cv, cf, cp, mult_slots, abort, overflow, dev, cap_f = self._expand_local(
+            frontier, msum, n_f
+        )
+        pos = jnp.searchsorted(visited, cv)
+        hit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == cv
+        cv = jnp.where(hit, SENT, cv)
+
+        gv = jax.lax.all_gather(cv, "d").reshape(-1)
+        gf = jax.lax.all_gather(cf, "d").reshape(-1)
+        gp = jax.lax.all_gather(cp, "d").reshape(-1)
+        gorder = jnp.lexsort((gp, gf, gv))
+        gsv, gpay = gv[gorder], gp[gorder]
+        gfirst = jnp.concatenate([jnp.ones((1,), bool), gsv[1:] != gsv[:-1]])
+        gnew = gfirst & (gsv != SENT)
+        n_new_total = gnew.sum().astype(I64)
+        win = gnew & (gpay // (self.K * cap_f) == dev)
+        n_new_local = win.sum().astype(I64)
+        wpay, wlane = _compact(win, self.cap_x, gpay, fills=(I64(0),))
+        children, child_msum, gpidx, slots, inv_bad, first_bad = self._children_from(
+            frontier, cap_f, dev, wpay, wlane
+        )
+        # replicated store update (identical on every device)
+        new_fps = jnp.where(gnew, gsv, SENT)
+        visited = jnp.sort(jnp.concatenate([visited, new_fps]))[: visited.shape[0] + self.D * self.cap_x]
         return LevelOut(
-            children, child_msum,
-            n_new_local[None], n_new_total, generated, new_fps,
-            jnp.where(wlane, pidx, -1), jnp.where(wlane, slots, -1),
-            abort, jax.lax.psum(overflow.astype(jnp.int32), "d") > 0,
+            children, child_msum, visited,
+            n_new_local[None], n_new_total,
+            mult_slots.sum(), mult_slots,
+            gpidx, jnp.where(wlane, slots, -1),
+            inv_bad, first_bad[None], abort,
+            jax.lax.psum(overflow.astype(I32), "d") > 0,
+        )
+
+    def _body_all_to_all(self, frontier, msum, n_f, visited):
+        """Owner-sharded dedup: fp % D owns; candidates route via all_to_all."""
+        D, cap_x = self.D, self.cap_x
+        cap_r = self.cap_r  # per-(src,dst) routing capacity
+        cv, cf, cp, mult_slots, abort, overflow, dev, cap_f = self._expand_local(
+            frontier, msum, n_f
+        )
+        # --- route to owners ---------------------------------------------
+        # sentinel lanes route to a virtual discard row D so they neither
+        # count toward a real bucket nor collide with real scatters
+        owner = jnp.where(cv == SENT, D, (cv % jnp.uint64(D)).astype(I64))
+        oorder = jnp.argsort(owner, stable=True)  # candidates grouped by owner
+        ov, of_, op, oo = cv[oorder], cf[oorder], cp[oorder], owner[oorder]
+        counts = jnp.bincount(oo, length=D + 1)
+        starts = jnp.cumsum(counts) - counts
+        rank = jnp.arange(cap_x) - starts[oo]
+        overflow = overflow | (counts[:D].max() > cap_r)
+        # scatter into the [D+1, cap_r] send buffer; slice off the discard row
+        sendv = jnp.full((D + 1, cap_r), SENT, U64)
+        sendf = jnp.full((D + 1, cap_r), SENT, U64)
+        sendp = jnp.full((D + 1, cap_r), -1, I64)
+        rr = jnp.clip(rank, 0, cap_r - 1)
+        ok_lane = (ov != SENT) & (rank < cap_r)
+        sendv = sendv.at[oo, rr].set(jnp.where(ok_lane, ov, SENT))[:D]
+        sendf = sendf.at[oo, rr].set(jnp.where(ok_lane, of_, SENT))[:D]
+        sendp = sendp.at[oo, rr].set(jnp.where(ok_lane, op, -1))[:D]
+        rv = jax.lax.all_to_all(sendv, "d", 0, 0, tiled=True).reshape(D, cap_r)
+        rf = jax.lax.all_to_all(sendf, "d", 0, 0, tiled=True).reshape(D, cap_r)
+        rp = jax.lax.all_to_all(sendp, "d", 0, 0, tiled=True).reshape(D, cap_r)
+
+        # --- owner-side dedup vs the store shard -------------------------
+        qv, qf, qp = rv.reshape(-1), rf.reshape(-1), rp.reshape(-1)
+        qorder = jnp.lexsort((qp, qf, qv))
+        qsv = qv[qorder]
+        qfirst = jnp.concatenate([jnp.ones((1,), bool), qsv[1:] != qsv[:-1]])
+        pos = jnp.searchsorted(visited, qsv)
+        qhit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == qsv
+        qnew = qfirst & (qsv != SENT) & ~qhit
+        n_own_new = qnew.sum()
+        # update the shard (sorted merge, fixed capacity)
+        vcount = (visited != SENT).sum()
+        overflow = overflow | (vcount + n_own_new > visited.shape[0])
+        upd = jnp.sort(
+            jnp.concatenate([visited, jnp.where(qnew, qsv, SENT)])
+        )[: visited.shape[0]]
+        # verdict bits back to origins, aligned to the recv layout
+        verdict_sorted = qnew
+        verdict = jnp.zeros(qv.shape[0], bool).at[qorder].set(verdict_sorted)
+        back = jax.lax.all_to_all(
+            verdict.reshape(D, cap_r), "d", 0, 0, tiled=True
+        ).reshape(D, cap_r)
+        # my candidate i (owner-grouped order) sits at (oo[i], rank[i])
+        win_sorted = back[jnp.clip(oo, 0, D - 1), rr] & ok_lane
+        n_new_total = jax.lax.psum(n_own_new.astype(I64), "d")
+        n_new_local = win_sorted.sum().astype(I64)
+        wpay, wlane = _compact(win_sorted, cap_x, op, fills=(I64(0),))
+        children, child_msum, gpidx, slots, inv_bad, first_bad = self._children_from(
+            frontier, cap_f, dev, wpay, wlane
+        )
+        return LevelOut(
+            children, child_msum, upd,
+            n_new_local[None], n_new_total,
+            mult_slots.sum(), mult_slots,
+            gpidx, jnp.where(wlane, slots, -1),
+            inv_bad, first_bad[None], abort,
+            jax.lax.psum(overflow.astype(I32), "d") > 0,
         )
 
     @functools.cached_property
+    def cap_r(self) -> int:
+        # routing capacity per (src, dst) pair: uniform hashing concentrates
+        # counts near cap_x/D; 4x slack + floor keeps overflow retries rare
+        return max(256, 4 * self.cap_x // self.D)
+
+    @functools.cached_property
     def level_step(self):
+        body = (
+            self._body_all_to_all
+            if self.exchange == "all_to_all"
+            else self._body_all_gather
+        )
         spec_state = jax.tree.map(lambda _: P("d"), init_batch(self.cfg, 1))
+        vspec = P("d") if self.exchange == "all_to_all" else P()
         return jax.jit(
             jax.shard_map(
-                self._level_body,
+                body,
                 mesh=self.mesh,
-                in_specs=(spec_state, P("d"), P("d"), P()),
+                in_specs=(spec_state, P("d"), P("d"), vspec),
                 out_specs=LevelOut(
                     jax.tree.map(lambda _: P("d"), init_batch(self.cfg, 1)),
-                    P("d"), P("d"), P(), P(), P(), P("d"), P("d"), P(), P(),
+                    P("d"), vspec, P("d"), P(), P(), P(),
+                    P("d"), P("d"), P(), P("d"), P(), P(),
                 ),
                 # the scatter-in-switch inside materialize trips the vma
                 # (varying-axis) type checker; the body is plain SPMD with
@@ -194,56 +324,161 @@ class ShardedChecker:
             )
         )
 
-    # -- minimal distributed run (tests + dry-run) ------------------------
+    # -- trace replay (slot chains are device-agnostic) --------------------
 
-    def run(self, max_depth: int | None = None):
-        """Distributed BFS to fixpoint; returns (distinct, generated, depth,
-        level_sizes).  Invariants/traces stay on the single-device engine;
-        this path is the scaling backend (verdict parity is established by
-        comparing distinct counts against it in tests)."""
+    def _trace(self, trace_levels, level, gidx):
+        chain = []
+        d, j = level, gidx
+        while d > 0:
+            gpidx, slots = trace_levels[d - 1]
+            chain.append(int(slots[j]))
+            j = int(gpidx[j])
+            d -= 1
+        chain.reverse()
+        st = init_batch(self.cfg, 1)
+        out = [("Init", to_oracle(self.cfg, st)[0])]
+        for slot in chain:
+            st = self.kern.materialize(st, jnp.asarray([slot], I64))
+            fam = int(self.kern.slot_family[slot])
+            name = self.kern.families[fam][0]
+            server = int(self.kern.slot_coords[slot, 0]) + 1
+            out.append((f"{name}({server})", to_oracle(self.cfg, st)[0]))
+        return out
+
+    def _action_counts(self, mult_slots: np.ndarray) -> dict:
+        out: dict[str, int] = {}
+        fam = self.kern.slot_family
+        for fi, (name, _fn, _c) in enumerate(self.kern.families):
+            out[name] = out.get(name, 0) + int(mult_slots[fam == fi].sum())
+        return {k: v for k, v in out.items() if v}
+
+    # -- the distributed run ----------------------------------------------
+
+    def run(self, max_depth: int | None = None) -> CheckResult:
         cfg, D = self.cfg, self.D
         mesh = self.mesh
         shard = NamedSharding(mesh, P("d"))
         repl = NamedSharding(mesh, P())
+        t0 = time.monotonic()
 
-        cap_f = 1
-        frontier = init_batch(cfg, D)  # one init copy per device lane
-        frontier = jax.device_put(frontier, shard)
+        frontier = jax.device_put(init_batch(cfg, D), shard)
         fv, _ff, msum = self.fpr.state_fingerprints(frontier)
         msum = jax.device_put(msum, shard)
-        # only device 0's lane is live
-        n_f = jax.device_put(
-            jnp.asarray([1] + [0] * (D - 1), I64), shard
-        )
-        visited = jnp.sort(
-            jnp.concatenate([fv.astype(U64)[:1], jnp.full((63,), SENT, U64)])
-        )
-        visited = jax.device_put(visited, repl)
+        n_f = jax.device_put(jnp.asarray([1] + [0] * (D - 1), I64), shard)
+        fp0 = np.asarray(fv.astype(U64))[0]
+        if self.exchange == "all_to_all":
+            vis = np.full((D, self.vcap), np.uint64(0xFFFFFFFFFFFFFFFF))
+            vis[int(fp0 % D), 0] = fp0
+            vis = np.sort(vis, axis=1)
+            visited = jax.device_put(jnp.asarray(vis).reshape(-1), shard)
+        else:
+            vis = np.full(self.vcap, np.uint64(0xFFFFFFFFFFFFFFFF))
+            vis[0] = fp0
+            visited = jax.device_put(jnp.asarray(np.sort(vis)), repl)
         distinct, generated, depth = 1, 0, 0
         level_sizes = [1]
+        trace_levels: list[tuple[np.ndarray, np.ndarray]] = []
+        mult_slots_total = np.zeros(self.K, np.int64)
+
+        # init-state invariants (host-side, single state)
+        from ..engine.bfs import JaxChecker  # reuse the batched kernels
+
+        ok0, _idx, name0 = JaxChecker(cfg)._check_invariants(
+            jax.device_put(init_batch(cfg, 1), repl), 1
+        )
+        if not ok0:
+            return CheckResult(
+                False, 1, 0, 0, (1,),
+                (f"Invariant {name0} is violated", self._trace([], 0, 0)), {},
+            )
+
+        def grow_visited(v, new_vcap):
+            """Pad every store shard (sorted, SENT tail) to a new capacity."""
+            arr = np.asarray(v).reshape(D, -1)
+            pad = np.full((D, new_vcap - arr.shape[1]), np.uint64(SENT))
+            self.vcap = new_vcap
+            return jax.device_put(
+                jnp.asarray(np.concatenate([arr, pad], axis=1)).reshape(-1), shard
+            )
 
         while True:
             if max_depth is not None and depth >= max_depth:
                 break
+            if self.exchange == "all_to_all" and distinct > D * self.vcap // 2:
+                visited = grow_visited(visited, self.vcap * 4)
             out = self.level_step(frontier, msum, n_f, visited)
             if bool(out.overflow):
+                if self.exchange == "all_to_all":
+                    # a shard (or routing lane) overflowed: grow and retry —
+                    # the level step is pure, so the failed outputs drop
+                    visited = grow_visited(visited, self.vcap * 4)
+                    out = self.level_step(frontier, msum, n_f, visited)
+            if bool(out.overflow):
                 raise RuntimeError(
-                    f"cap_x={self.cap_x} overflow at level {depth + 1}; "
-                    "re-run with a larger capacity"
+                    f"capacity overflow at level {depth + 1} "
+                    f"(cap_x={self.cap_x}, cap_r={self.cap_r}, "
+                    f"vcap={self.vcap}); re-run with larger capacities"
                 )
+            if bool(out.abort):
+                # locate the aborting parent on the host (rare path)
+                return CheckResult(
+                    False, distinct, generated, depth, tuple(level_sizes),
+                    ('Assert "split brain" (Raft.tla:185)', None),
+                    self._action_counts(mult_slots_total),
+                )
+            mult_slots_total += np.asarray(out.mult_slots)
+            generated += int(np.asarray(out.generated))
             n_new = int(out.n_new_total)
-            generated += int(out.generated)
             if n_new == 0:
                 break
             distinct += n_new
             level_sizes.append(n_new)
             depth += 1
-            # merge new fps (replicated) into the replicated store
-            visited = jnp.sort(jnp.concatenate([visited, out.new_fps_global]))[
-                : 1 << max(6, (distinct + 1).bit_length())
-            ]
-            visited = jax.device_put(visited, repl)
-            frontier = out.children
-            msum = out.child_msum
+            trace_levels.append(
+                (np.asarray(out.gpidx).astype(np.int64),
+                 np.asarray(out.slots).astype(np.int64))
+            )
+            visited = out.visited
+            if self.exchange == "all_gather":
+                # the replicated store grows by D*cap_x sentinel-padded slots
+                # per level; trim it back on the host
+                keep = max(4096, 1 << (distinct + 64).bit_length())
+                visited = jax.device_put(out.visited[:keep], repl)
+            frontier, msum = out.children, out.child_msum
             n_f = jax.device_put(out.n_new_local, shard)
-        return distinct, generated, depth, tuple(level_sizes)
+            if self.progress is not None:
+                self.progress(
+                    dict(
+                        level=depth, frontier=n_new, distinct=distinct,
+                        generated=generated, elapsed=time.monotonic() - t0,
+                    )
+                )
+            if int(np.asarray(out.inv_bad)) > 0:
+                bad_at = np.asarray(out.inv_bad_at)
+                devs = np.nonzero(bad_at >= 0)[0]
+                gidx = int(devs[0]) * (out.children.voted_for.shape[0] // D) + int(
+                    bad_at[devs[0]]
+                )
+                trace = self._trace(trace_levels, depth, gidx)
+                # identify which configured invariant tripped by re-checking
+                # the violating state host-side
+                from ..oracle.explicit import resolve_invariant
+
+                name = next(
+                    (
+                        n
+                        for n in cfg.invariants
+                        if not resolve_invariant(n)(cfg, trace[-1][1])
+                    ),
+                    cfg.invariants[0],
+                )
+                return CheckResult(
+                    False, distinct, generated, depth, tuple(level_sizes),
+                    (f"Invariant {name} is violated", trace),
+                    self._action_counts(mult_slots_total),
+                )
+
+        return CheckResult(
+            True, distinct, generated, depth, tuple(level_sizes), None,
+            self._action_counts(mult_slots_total),
+        )

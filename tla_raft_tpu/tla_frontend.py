@@ -1,0 +1,130 @@
+"""Structural front-end for the TLA+ spec input.
+
+The TPU checker compiles *this spec family* — the Raft model of
+/root/reference/Raft.tla — rather than interpreting arbitrary TLA+
+(SURVEY.md §7.2 step 1).  The transition semantics live in
+ops/successor.py; this module closes the loop on the spec *file* as an
+input: it extracts the structural skeleton (constants, variables, the
+``view`` projection, the ``Next`` disjuncts, the bound invariant) and
+verifies it against what the kernels implement, so a drifted or edited
+spec fails loudly instead of being silently mischecked.
+
+This is deliberately regex-level structure extraction, not a TLA+
+parser: it must accept exactly the reference spec and reject structural
+deviations from it.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import NamedTuple
+
+# What ops/successor.py implements (the 11 live Next disjuncts,
+# Raft.tla:416-430) and the state/constant skeleton it assumes.
+EXPECTED_ACTIONS = (
+    "BecomeCandidate",
+    "UpdateTerm",
+    "ResponseVote",
+    "BecomeLeader",
+    "ClientReq",
+    "LeaderAppendEntry",
+    "FollowerAcceptEntry",
+    "FollowerRejectEntry",
+    "HandleAppendResp",
+    "LeaderCanCommit",
+    "Restart",
+)
+EXPECTED_CONSTANTS = {
+    "Servers", "VoteReq", "VoteResp", "AppendReq", "AppendResp", "None",
+    "MaxElection", "MaxRestart", "Follower", "Candidate", "Leader", "Vals",
+}
+EXPECTED_VARIABLES = {
+    "votedFor", "currentTerm", "logs", "matchIndex", "nextIndex",
+    "commitIndex", "msgs", "role", "electionCount", "restartCount",
+    "pendingResponse", "valSent",
+}
+# The VIEW projection (Raft.tla:38): the 8 real vars, aux excluded.
+EXPECTED_VIEW = (
+    "votedFor", "currentTerm", "logs", "matchIndex", "nextIndex",
+    "commitIndex", "msgs", "role",
+)
+
+
+class SpecSkeleton(NamedTuple):
+    constants: frozenset
+    variables: frozenset
+    view: tuple
+    next_actions: tuple
+    invariant_binding: str | None  # what ``Inv ==`` resolves to
+
+
+def _strip_comments(text: str) -> str:
+    text = re.sub(r"\(\*.*?\*\)", " ", text, flags=re.S)
+    return "\n".join(line.split("\\*")[0] for line in text.splitlines())
+
+
+def extract_skeleton(text: str) -> SpecSkeleton:
+    src = _strip_comments(text)
+
+    consts: set[str] = set()
+    for m in re.finditer(r"^CONSTANTS?\b(.*)$", src, re.M):
+        consts.update(x.strip() for x in m.group(1).split(",") if x.strip())
+
+    variables: set[str] = set()
+    for m in re.finditer(r"^VARIABLES?\b(.*)$", src, re.M):
+        variables.update(x.strip() for x in m.group(1).split(",") if x.strip())
+
+    view: tuple = ()
+    vm = re.search(r"^view\s*==\s*<<(.*?)>>", src, re.M | re.S)
+    if vm:
+        view = tuple(x.strip() for x in vm.group(1).split(",") if x.strip())
+
+    # Next == ... block: collect Action(...) applications in its disjuncts
+    next_actions: list[str] = []
+    nm = re.search(r"^Next\s*==(.*?)(?=^\S|\Z)", src, re.M | re.S)
+    if nm:
+        for am in re.finditer(r"\\/\s*([A-Za-z]\w*)\s*\(", nm.group(1)):
+            next_actions.append(am.group(1))
+
+    inv = None
+    im = re.search(r"^Inv\s*==\s*(?:/\\\s*)?([A-Za-z]\w*)", src, re.M)
+    if im:
+        inv = im.group(1)
+
+    return SpecSkeleton(
+        frozenset(consts), frozenset(variables), view, tuple(next_actions), inv
+    )
+
+
+def validate_spec(path: str) -> list[str]:
+    """Returns a list of structural mismatches (empty = spec matches the
+    compiled semantics)."""
+    with open(path) as f:
+        sk = extract_skeleton(f.read())
+    problems = []
+    if not EXPECTED_CONSTANTS <= sk.constants:
+        problems.append(
+            f"missing CONSTANT declarations: {sorted(EXPECTED_CONSTANTS - sk.constants)}"
+        )
+    if sk.variables != EXPECTED_VARIABLES:
+        problems.append(
+            "VARIABLES differ from the compiled 12-variable state: "
+            f"extra={sorted(sk.variables - EXPECTED_VARIABLES)}, "
+            f"missing={sorted(EXPECTED_VARIABLES - sk.variables)}"
+        )
+    if sk.view != EXPECTED_VIEW:
+        problems.append(
+            f"VIEW projection differs: spec has {sk.view}, compiled semantics "
+            f"fingerprint {EXPECTED_VIEW}"
+        )
+    if tuple(sorted(set(sk.next_actions))) != tuple(sorted(EXPECTED_ACTIONS)):
+        problems.append(
+            "Next disjuncts differ from the 11 compiled actions: "
+            f"spec={sorted(set(sk.next_actions))}"
+        )
+    if sk.invariant_binding != "LeaderHasAllCommittedEntries":
+        problems.append(
+            f"Inv binds {sk.invariant_binding!r}, compiled invariant is "
+            "LeaderHasAllCommittedEntries"
+        )
+    return problems
