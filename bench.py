@@ -7,12 +7,19 @@ Inv invariant, exactly what `./myrun.sh` runs (BASELINE.md config 1/2).
 Baseline: the reference publishes no numbers and its checker (TLC) is an
 external Java tool that is not vendored (and cannot be fetched in this
 zero-egress environment), so the recorded CPU baseline is this repo's
-pure-Python oracle — the same semantics, measured on a depth-capped prefix
-of the same workload (BASELINE.md "first measurement task").
+pure-Python oracle — the same semantics, measured once on a depth-capped
+prefix of the same workload (BASELINE.md "first measurement task").
+
+Self-verification (a correctness gate, not just a timer): the oracle
+prefix run doubles as a golden answer — the engine's per-level state
+counts must match it level for level, and the engine must report a clean
+sweep (the reference config is known violation-free).  A mismatch or an
+`ok:false` makes this benchmark FAIL (exit 1) instead of reporting a
+number for a wrong computation.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "distinct_states_per_sec",
-   "vs_baseline": N, ...}
+   "vs_baseline": N, "parity": true, ...}
 """
 
 from __future__ import annotations
@@ -21,21 +28,6 @@ import json
 import os
 import sys
 import time
-
-
-def measure_oracle(cfg, budget_s: float = 20.0):
-    """Oracle distinct-states/sec on a depth-capped prefix of the workload."""
-    from tla_raft_tpu.oracle import OracleChecker
-
-    best = None
-    for depth in range(4, 64):
-        t0 = time.monotonic()
-        res = OracleChecker(cfg).run(max_depth=depth)
-        dt = time.monotonic() - t0
-        best = (res.distinct / dt, res.distinct, depth, dt)
-        if dt > budget_s or res.depth < depth:
-            break
-    return best
 
 
 def main():
@@ -49,14 +41,23 @@ def main():
 
     from tla_raft_tpu.cfgparse import load_raft_config
     from tla_raft_tpu.engine import JaxChecker
+    from tla_raft_tpu.oracle import OracleChecker
 
     cfg = load_raft_config(
         os.environ.get("RAFT_CFG", "/root/reference/Raft.cfg")
     )
     max_depth = int(os.environ.get("BENCH_MAX_DEPTH", "0")) or None
-    chunk = int(os.environ.get("BENCH_CHUNK", "256"))
+    chunk = int(os.environ.get("BENCH_CHUNK", "1024"))
+    gold_depth = int(os.environ.get("BENCH_GOLD_DEPTH", "12"))
+    if max_depth is not None:
+        gold_depth = min(gold_depth, max_depth)
 
-    oracle_rate, o_states, o_depth, o_dt = measure_oracle(cfg)
+    # one timed oracle run: the CPU baseline rate AND the golden prefix
+    t0 = time.monotonic()
+    gold = OracleChecker(cfg).run(max_depth=gold_depth)
+    o_dt = time.monotonic() - t0
+    oracle_rate = gold.distinct / o_dt
+    assert gold.ok, "oracle found a violation on a known-clean config"
 
     # warm-up run compiles every kernel shape (cached persistently), then
     # the timed run measures steady-state throughput
@@ -67,34 +68,47 @@ def main():
     t1 = time.monotonic()
     res2 = JaxChecker(cfg, chunk=chunk).run(max_depth=max_depth)
     dt2 = time.monotonic() - t1
-    assert res2.distinct == res.distinct
     rate = res2.distinct / dt2
 
-    print(
-        json.dumps(
-            {
-                "metric": "raft_cfg_full_check",
-                "value": round(rate, 1),
-                "unit": "distinct_states_per_sec",
-                "vs_baseline": round(rate / oracle_rate, 2),
-                "distinct": res2.distinct,
-                "generated": res2.generated,
-                "depth": res2.depth,
-                "ok": res2.ok,
-                "wall_s": round(dt2, 2),
-                "cold_wall_s": round(dt, 2),
-                "baseline": {
-                    "impl": "python_oracle",
-                    "rate": round(oracle_rate, 1),
-                    "states": o_states,
-                    "depth_cap": o_depth,
-                    "wall_s": round(o_dt, 2),
-                },
-                "device": str(jax.devices()[0]),
-            }
-        )
+    # ---- parity gate ----------------------------------------------------
+    prefix = gold.level_sizes
+    parity = (
+        res2.ok
+        and res.ok
+        and res2.distinct == res.distinct
+        and res2.level_sizes == res.level_sizes
+        and res2.level_sizes[: len(prefix)] == prefix
     )
-    return 0
+    out = {
+        "metric": "raft_cfg_full_check",
+        "value": round(rate, 1),
+        "unit": "distinct_states_per_sec",
+        "vs_baseline": round(rate / oracle_rate, 2),
+        "parity": parity,
+        "distinct": res2.distinct,
+        "generated": res2.generated,
+        "depth": res2.depth,
+        "ok": res2.ok,
+        "wall_s": round(dt2, 2),
+        "cold_wall_s": round(dt, 2),
+        "baseline": {
+            "impl": "python_oracle",
+            "rate": round(oracle_rate, 1),
+            "states": gold.distinct,
+            "depth_cap": gold_depth,
+            "wall_s": round(o_dt, 2),
+        },
+        "device": str(jax.devices()[0]),
+    }
+    if not parity:
+        out["error"] = {
+            "engine_levels": list(res2.level_sizes[: len(prefix) + 2]),
+            "golden_levels": list(prefix),
+            "engine_ok": res2.ok,
+            "violation": str(res2.violation[0]) if res2.violation else None,
+        }
+    print(json.dumps(out))
+    return 0 if parity else 1
 
 
 if __name__ == "__main__":

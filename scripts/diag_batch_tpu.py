@@ -1,0 +1,129 @@
+"""Diagnostic 4: characterize the batch-256 expand miscompile on TPU.
+
+- family histogram of bad slots
+- does badness follow the batch row or the state? (shuffle experiment)
+- does a smaller batch shape (64) still miscompile?
+
+Usage: PYTHONPATH=. python scripts/diag_batch_tpu.py [--cpu]
+"""
+
+import collections
+import sys
+
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import os
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/tla_raft_tpu_jax")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.models.raft import encode_np, from_oracle
+from tla_raft_tpu.ops.fingerprint import get_fingerprinter
+from tla_raft_tpu.ops.msg_universe import get_universe
+from tla_raft_tpu.ops.successor import get_kernel
+from tla_raft_tpu.oracle.explicit import canonical_key, init_state, successors
+
+cfg = load_raft_config("/root/reference/Raft.cfg")
+print("backend:", jax.default_backend())
+kern = get_kernel(cfg)
+fpr = kern.fpr
+uni = get_universe(cfg)
+perms = cfg.server_perms()
+
+init = init_state(cfg)
+seen = {canonical_key(cfg, init, perms)}
+states = [init]
+frontier = [init]
+while len(states) < 256:
+    nxt = []
+    for st in frontier:
+        for _a, _s, _det, ch in successors(cfg, st):
+            k = canonical_key(cfg, ch, perms)
+            if k not in seen:
+                seen.add(k)
+                states.append(ch)
+                nxt.append(ch)
+    frontier = nxt
+states = states[:256]
+K = kern.K
+
+
+def ref_multiset(st):
+    succs = successors(cfg, st)
+    flat = [ch for _a, _s, _d, ch in succs]
+    if not flat:
+        return collections.Counter()
+    arrs = encode_np(cfg, flat)
+    bits = uni.unpack_bits(arrs["msgs"])
+    ev, _ = fpr.fingerprints_np(arrs, bits)
+    return collections.Counter(ev.tolist())
+
+
+refs = [ref_multiset(st) for st in states]
+
+
+def run_expand(sts):
+    batch = from_oracle(cfg, sts)
+    _, _, msum = jax.jit(fpr.state_fingerprints)(batch)
+    exp = kern.expand(batch, msum)
+    return (
+        np.asarray(exp.valid),
+        np.asarray(exp.mult),
+        np.asarray(exp.fp_view),
+    )
+
+
+def bad_info(order):
+    sts = [states[i] for i in order]
+    valid, mult, fpv = run_expand(sts)
+    bad_states = []
+    fams = collections.Counter()
+    for row, sid in enumerate(order):
+        got = collections.Counter()
+        for k in np.nonzero(valid[row])[0]:
+            got[int(fpv[row, k])] += int(mult[row, k])
+        if got != refs[sid]:
+            bad_states.append((row, sid))
+            extra = got - refs[sid]
+            for k in np.nonzero(valid[row])[0]:
+                if int(fpv[row, k]) in extra:
+                    fams[kern.families[int(kern.slot_family[k])][0]] += 1
+    return bad_states, fams
+
+
+fwd, fams = bad_info(list(range(256)))
+print(f"forward order: {len(fwd)} bad states; family histogram: {dict(fams)}")
+rev, fams_r = bad_info(list(reversed(range(256))))
+print(f"reversed order: {len(rev)} bad states; families: {dict(fams_r)}")
+fwd_sids = {sid for _r, sid in fwd}
+rev_sids = {sid for _r, sid in rev}
+fwd_rows = {r for r, _s in fwd}
+rev_rows = {r for r, _s in rev}
+print(f"bad sid overlap fwd∩rev: {len(fwd_sids & rev_sids)} "
+      f"(fwd {len(fwd_sids)}, rev {len(rev_sids)})")
+print(f"bad row overlap fwd∩rev: {len(fwd_rows & rev_rows)}")
+
+# batch-64 program: same states in 4 chunks
+bad64 = []
+for c in range(4):
+    order = list(range(64 * c, 64 * (c + 1)))
+    sts = [states[i] for i in order]
+    valid, mult, fpv = run_expand(sts)
+    for row, sid in enumerate(order):
+        got = collections.Counter()
+        for k in np.nonzero(valid[row])[0]:
+            got[int(fpv[row, k])] += int(mult[row, k])
+        if got != refs[sid]:
+            bad64.append(sid)
+print(f"batch-64 program: {len(bad64)} bad states")

@@ -1,0 +1,128 @@
+"""Diagnostic 5: _chunk_dedup/_level_dedup on device vs numpy, at the
+exact shapes the depth-13 TPU run used (C=712704, cap_x=8192, small
+visited stores).
+
+Usage: PYTHONPATH=. python scripts/diag_dedup_tpu.py [--cpu]
+"""
+
+import sys
+
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import os
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/tla_raft_tpu_jax")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from tla_raft_tpu.engine.bfs import _chunk_dedup, _level_dedup
+
+print("backend:", jax.default_backend())
+SENT = np.uint64(0xFFFFFFFFFFFFFFFF)
+rng = np.random.default_rng(7)
+
+
+def ref_chunk(fv, ff, fp, visited, cap_x):
+    """Numpy reference of _chunk_dedup semantics."""
+    live = fv != SENT
+    vis_real = visited[visited != SENT]
+    out = {}
+    for i in np.nonzero(live)[0]:
+        v = fv[i]
+        if np.searchsorted(vis_real, v) < len(vis_real) and vis_real[
+            np.searchsorted(vis_real, v)
+        ] == v:
+            continue
+        key = (ff[i], fp[i])
+        if v not in out or key < out[v]:
+            out[v] = key
+    items = sorted(out.items())  # ascending view fp
+    n = len(items)
+    cv = np.full(cap_x, SENT)
+    cf = np.full(cap_x, SENT)
+    cp = np.full(cap_x, -1, np.int64)
+    for j, (v, (f, p)) in enumerate(items[:cap_x]):
+        cv[j], cf[j], cp[j] = v, f, p
+    return n, cv, cf, cp
+
+
+def trial(C, n_live, n_unique, vis_size, n_vis_hits, cap_x, tag):
+    fv = np.full(C, SENT)
+    ff = np.full(C, SENT)
+    fp = np.arange(C, dtype=np.int64)
+    pos = rng.choice(C, n_live, replace=False)
+    pool = rng.integers(0, 1 << 63, n_unique, dtype=np.uint64)
+    fv[pos] = pool[rng.integers(0, n_unique, n_live)]
+    ff[pos] = rng.integers(0, 1 << 63, n_live, dtype=np.uint64)
+    vis = np.full(vis_size, SENT)
+    hits = rng.choice(pool, min(n_vis_hits, n_unique, vis_size), replace=False)
+    vis[: len(hits)] = hits
+    vis = np.sort(vis)
+
+    n_dev, cv_d, cf_d, cp_d = jax.device_get(
+        _chunk_dedup(
+            jnp.asarray(fv), jnp.asarray(ff), jnp.asarray(fp),
+            jnp.asarray(vis), cap_x,
+        )[:4]
+    )
+    n_ref, cv_r, cf_r, cp_r = ref_chunk(fv, ff, fp, vis, cap_x)
+    ok = (
+        int(n_dev) == n_ref
+        and np.array_equal(cv_d, cv_r)
+        and np.array_equal(cf_d, cf_r)
+        and np.array_equal(cp_d, cp_r)
+    )
+    print(f"chunk_dedup[{tag}] C={C} live={n_live} uniq={n_unique} "
+          f"vis={vis_size}: dev n={int(n_dev)} ref n={n_ref} match={ok}")
+    if not ok:
+        bad = np.nonzero(cv_d != cv_r)[0]
+        print("  first diffs at lanes", bad[:5])
+        for b in bad[:3]:
+            print(f"   lane {b}: dev ({hex(int(cv_d[b]))},{hex(int(cf_d[b]))},{cp_d[b]}) "
+                  f"ref ({hex(int(cv_r[b]))},{hex(int(cf_r[b]))},{cp_r[b]})")
+    return ok
+
+
+C = 1024 * 696  # chunk=1024 shape from the depth-13 run
+all_ok = True
+for vis_size, tag in [(64, "L1"), (4, "L2"), (16, "L3"), (64, "L4")]:
+    all_ok &= trial(C, n_live=rng.integers(20, 400), n_unique=30,
+                    vis_size=vis_size, n_vis_hits=8, cap_x=8192, tag=tag)
+# denser trial
+all_ok &= trial(C, n_live=20000, n_unique=3000, vis_size=4096,
+                n_vis_hits=1000, cap_x=8192, tag="dense")
+
+# _level_dedup at the single-chunk shape
+cv = np.full(8192, SENT)
+cf = np.full(8192, SENT)
+cp = np.full(8192, -1, np.int64)
+m = 700
+pool = rng.integers(0, 1 << 63, 300, dtype=np.uint64)
+cv[:m] = np.sort(pool[rng.integers(0, 300, m)])
+cf[:m] = rng.integers(0, 1 << 63, m, dtype=np.uint64)
+cp[:m] = rng.integers(0, 1 << 40, m)
+n_dev, nf_d, npay_d = jax.device_get(
+    _level_dedup(jnp.asarray(cv), jnp.asarray(cf), jnp.asarray(cp))
+)
+# reference
+out = {}
+for i in range(m):
+    key = (cf[i], cp[i])
+    if cv[i] not in out or key < out[cv[i]]:
+        out[cv[i]] = key
+items = sorted(out.items())
+ok = int(n_dev) == len(items) and all(
+    nf_d[j] == v and npay_d[j] == p for j, (v, (f, p)) in enumerate(items)
+)
+print(f"level_dedup: dev n={int(n_dev)} ref n={len(items)} match={ok}")
+all_ok &= ok
+print("ALL OK" if all_ok else "FAILURES PRESENT")

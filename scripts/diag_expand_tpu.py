@@ -1,0 +1,158 @@
+"""Diagnostic 2: expand + materialize on the current backend vs the oracle.
+
+For every oracle-reachable state to a depth cap:
+  A. expand()'s per-slot (valid, mult, fp_view) multiset must equal the
+     oracle successors' canonical fingerprints (numpy reference hash).
+  B. materialize() of each valid slot must rebuild a state whose
+     device-recomputed fingerprint equals expand()'s incremental one.
+
+Usage: PYTHONPATH=. python scripts/diag_expand_tpu.py [depth] [--cpu]
+"""
+
+import collections
+import sys
+
+depth = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import os
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/tla_raft_tpu_jax")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.models.raft import encode_np, from_oracle
+from tla_raft_tpu.ops.fingerprint import get_fingerprinter
+from tla_raft_tpu.ops.msg_universe import get_universe
+from tla_raft_tpu.ops.successor import get_kernel
+from tla_raft_tpu.oracle.explicit import (
+    canonical_key,
+    init_state,
+    successors,
+)
+
+cfg = load_raft_config("/root/reference/Raft.cfg")
+print("backend:", jax.default_backend())
+kern = get_kernel(cfg)
+fpr = kern.fpr
+uni = get_universe(cfg)
+perms = cfg.server_perms()
+
+# BFS exactly as the oracle does (canonical-key dedup), keep all states
+init = init_state(cfg)
+seen = {canonical_key(cfg, init, perms)}
+states = [init]
+frontier = [init]
+d = 0
+while frontier and d < depth:
+    nxt = []
+    for st in frontier:
+        for _a, _s, _det, ch in successors(cfg, st):
+            k = canonical_key(cfg, ch, perms)
+            if k not in seen:
+                seen.add(k)
+                states.append(ch)
+                nxt.append(ch)
+    frontier = nxt
+    d += 1
+print("captured", len(states), "states to depth", d)
+
+
+def ref_fps(sts):
+    arrs = encode_np(cfg, sts)
+    bits = uni.unpack_bits(arrs["msgs"])
+    return fpr.fingerprints_np(arrs, bits)
+
+
+B = int(__import__("os").environ.get("DIAG_B", "256"))
+n = len(states)
+pad = (-n) % B
+batch = from_oracle(cfg, states + [states[0]] * pad)
+K = kern.K
+
+valid = np.empty((n + pad, K), bool)
+mult = np.empty((n + pad, K), np.int32)
+fpv = np.empty((n + pad, K), np.uint64)
+fpf = np.empty((n + pad, K), np.uint64)
+sf = jax.jit(fpr.state_fingerprints)
+for i in range(0, n + pad, B):
+    part = jax.tree.map(lambda x: x[i : i + B], batch)
+    _, _, msum = sf(part)
+    exp = kern.expand(part, msum)
+    assert not np.asarray(exp.abort).any()
+    valid[i : i + B] = np.asarray(exp.valid)
+    mult[i : i + B] = np.asarray(exp.mult)
+    fpv[i : i + B] = np.asarray(exp.fp_view)
+    fpf[i : i + B] = np.asarray(exp.fp_full)
+
+# A. multiset parity vs oracle successors
+all_succs = [successors(cfg, st) for st in states]
+flat = [ch for ss in all_succs for _a, _s, _d, ch in ss]
+ev, _ = ref_fps(flat)
+off = 0
+bad_a = 0
+fam_hist = collections.Counter()
+for i, succs in enumerate(all_succs):
+    want = collections.Counter(ev[off : off + len(succs)].tolist())
+    off += len(succs)
+    got = collections.Counter()
+    for k in np.nonzero(valid[i])[0]:
+        got[int(fpv[i, k])] += int(mult[i, k])
+    if got != want:
+        bad_a += 1
+        ex = got - want
+        for k in np.nonzero(valid[i])[0]:
+            if int(fpv[i, k]) in ex:
+                fam_hist[kern.families[int(kern.slot_family[k])][0]] += 1
+        if bad_a == 1:
+            print(f"A: FIRST MISMATCH at state {i}")
+            missing = want - got
+            extra = got - want
+            print("  missing:", {hex(k): v for k, v in list(missing.items())[:5]})
+            print("  extra:", {hex(k): v for k, v in list(extra.items())[:5]})
+            ks = [int(k) for k in np.nonzero(valid[i])[0]]
+            for k in ks:
+                if int(fpv[i, k]) in extra:
+                    fam = int(kern.slot_family[k])
+                    print(f"  extra slot {k}: family {kern.families[fam][0]} "
+                          f"coords {kern.slot_coords[k]}")
+print(f"A. expand multiset parity: {n - bad_a}/{n} states clean, {bad_a} bad")
+if fam_hist:
+    print("   bad-slot families:", dict(fam_hist))
+
+# B. materialize each valid slot; recomputed fp must equal incremental fp
+pi, ki = np.nonzero(valid[:n])
+m = len(pi)
+MB = 512
+mpad = (-m) % MB
+pi_p = np.concatenate([pi, np.zeros(mpad, pi.dtype)])
+ki_p = np.concatenate([ki, np.zeros(mpad, ki.dtype)])
+bad_b = 0
+mat = jax.jit(
+    lambda st, slots: kern.materialize(st, slots)
+)
+for i in range(0, m + mpad, MB):
+    parents = jax.tree.map(lambda x: x[pi_p[i : i + MB]], batch)
+    children = mat(parents, jnp.asarray(ki_p[i : i + MB], jnp.int64))
+    cv, cf, _ = sf(children)
+    cv, cf = np.asarray(cv), np.asarray(cf)
+    stop = min(i + MB, m)
+    for j in range(i, stop):
+        if cv[j - i] != fpv[pi[j], ki[j]] or cf[j - i] != fpf[pi[j], ki[j]]:
+            bad_b += 1
+            if bad_b == 1:
+                fam = int(kern.slot_family[ki[j]])
+                print(f"B: FIRST MISMATCH state {pi[j]} slot {ki[j]} "
+                      f"family {kern.families[fam][0]} coords {kern.slot_coords[ki[j]]}")
+                print(f"  materialized fp {hex(int(cv[j-i]))} vs expand {hex(int(fpv[pi[j], ki[j]]))}")
+print(f"B. materialize-vs-expand fp parity: {m - bad_b}/{m} slots clean, {bad_b} bad")

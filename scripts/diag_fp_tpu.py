@@ -1,0 +1,116 @@
+"""Diagnostic: compare device fingerprints vs the numpy reference path.
+
+Runs the Python oracle to a depth cap, encodes every reachable state, and
+checks that the device's `state_fingerprints` (and the expand kernel's
+incremental child fingerprints) agree with `Fingerprinter.fingerprints_np`
+on the current backend. Localizes platform-specific kernel bugs.
+
+Usage: python scripts/diag_fp_tpu.py [depth] [--cpu]
+"""
+
+import sys
+
+depth = int(sys.argv[1]) if len(sys.argv) > 1 else 9
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import os
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/tla_raft_tpu_jax")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.models.raft import encode_np, from_oracle
+from tla_raft_tpu.ops.fingerprint import get_fingerprinter
+from tla_raft_tpu.ops.msg_universe import get_universe
+from tla_raft_tpu.oracle import OracleChecker
+
+cfg = load_raft_config("/root/reference/Raft.cfg")
+print("backend:", jax.default_backend())
+
+chk = OracleChecker(cfg)
+res = chk.run(max_depth=depth)
+print("oracle:", res.distinct, "distinct, levels", res.level_sizes)
+
+# re-run to capture the states list (run() doesn't expose it)
+states = []
+import tla_raft_tpu.oracle.explicit as ex
+
+init = ex.init_state(cfg)
+seen = {ex.canonical_key(cfg, init, chk.perms)}
+states.append(init)
+frontier = [init]
+d = 0
+while frontier and d < depth:
+    groups = {}
+    for st in frontier:
+        for action, s, _det, nxt in ex.successors(cfg, st):
+            key = ex.canonical_key(cfg, nxt, chk.perms)
+            if key in seen:
+                continue
+            groups.setdefault(key, []).append(nxt)
+    nf = []
+    import dataclasses
+
+    full_cfg = dataclasses.replace(cfg, use_view=False)
+    for key, cands in groups.items():
+        if len(cands) > 1:
+            dis = {}
+            for c in cands:
+                dis.setdefault(ex.canonical_key(full_cfg, c, chk.perms), c)
+            cands = list(dis.values())
+        if len(cands) > 1:
+            cands.sort(key=lambda c: chk._full_fp(c))
+        seen.add(key)
+        nf.append(cands[0])
+    states.extend(nf)
+    frontier = nf
+    d += 1
+print("captured", len(states), "states")
+
+fpr = get_fingerprinter(cfg)
+uni = get_universe(cfg)
+arrs = encode_np(cfg, states)
+bits = uni.unpack_bits(arrs["msgs"])
+ref_view, ref_full = fpr.fingerprints_np(arrs, bits)
+
+batch = from_oracle(cfg, states)
+sf = jax.jit(fpr.state_fingerprints)
+# chunk to one fixed shape
+B = 512
+n = len(states)
+dev_view = np.empty(n, np.uint64)
+dev_full = np.empty(n, np.uint64)
+pad = (-n) % B
+padded = jax.tree.map(
+    lambda x: jnp.concatenate([x, jnp.repeat(x[:1], pad, axis=0)]) if pad else x, batch
+)
+for i in range(0, n + pad, B):
+    part = jax.tree.map(lambda x: x[i : i + B], padded)
+    fv, ff, _ = sf(part)
+    fv, ff = np.asarray(fv), np.asarray(ff)
+    stop = min(i + B, n)
+    dev_view[i:stop] = fv[: stop - i]
+    dev_full[i:stop] = ff[: stop - i]
+
+bad_v = np.nonzero(dev_view != ref_view)[0]
+bad_f = np.nonzero(dev_full != ref_full)[0]
+print(f"state_fingerprints: view mismatches {len(bad_v)}/{n}, full {len(bad_f)}/{n}")
+if len(bad_v):
+    i = int(bad_v[0])
+    print(" first bad:", i, hex(int(dev_view[i])), "vs ref", hex(int(ref_view[i])))
+
+# uniqueness cross-check: states are all canonically distinct, so all view
+# fps must be distinct (collision prob ~ n^2/2^64 ~ 0)
+u = len(np.unique(ref_view))
+ud = len(np.unique(dev_view))
+print(f"unique view fps: ref {u}/{n}, dev {ud}/{n}")

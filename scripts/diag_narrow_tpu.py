@@ -1,0 +1,120 @@
+"""Diagnostic 3: narrow the TPU expand miscompile to a minimal repro.
+
+State 149 (depth-8 BFS order), slot 30 = ClientReq(s=2, v=2): expand's
+fp_view is wrong on TPU while materialize+rehash is right. ClientReq adds
+no messages, so both paths compute feat_hash(features(child)) + msum —
+the difference is only program structure. Bisect which stage miscompiles.
+
+Usage: PYTHONPATH=. python scripts/diag_narrow_tpu.py [--cpu]
+"""
+
+import sys
+
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import os
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/tla_raft_tpu_jax")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.models.raft import encode_np, from_oracle
+from tla_raft_tpu.ops.fingerprint import get_fingerprinter
+from tla_raft_tpu.ops.msg_universe import get_universe
+from tla_raft_tpu.ops.successor import get_kernel
+from tla_raft_tpu.oracle.explicit import canonical_key, init_state, successors
+
+cfg = load_raft_config("/root/reference/Raft.cfg")
+print("backend:", jax.default_backend())
+kern = get_kernel(cfg)
+fpr = kern.fpr
+uni = get_universe(cfg)
+perms = cfg.server_perms()
+
+init = init_state(cfg)
+seen = {canonical_key(cfg, init, perms)}
+states = [init]
+frontier = [init]
+for _ in range(8):
+    nxt = []
+    for st in frontier:
+        for _a, _s, _det, ch in successors(cfg, st):
+            k = canonical_key(cfg, ch, perms)
+            if k not in seen:
+                seen.add(k)
+                states.append(ch)
+                nxt.append(ch)
+    frontier = nxt
+    if len(states) > 200:
+        break
+
+st149 = states[149]
+batch1 = from_oracle(cfg, [st149])
+st1 = jax.tree.map(lambda x: x[0], batch1)  # no batch dim
+SLOT = 30
+fam = int(kern.slot_family[SLOT])
+name, fn, coords_np = kern.families[fam]
+# witness index within the family grid
+base = int(np.sum([c.shape[0] for _, _, c in kern.families[:fam]]))
+w = SLOT - base
+cw = jnp.asarray(kern.slot_coords[SLOT])
+print(f"slot {SLOT} -> family {name}, witness {w}, coords {np.asarray(cw)}")
+
+# ground truth: materialize child on host path
+_valid, _mult, child, added, _ab = fn(st1, cw)
+child_arrs = {k: np.asarray(v)[None] for k, v in child._asdict().items()}
+bits = uni.unpack_bits(child_arrs["msgs"])
+ref_v, ref_f = fpr.fingerprints_np(child_arrs, bits)
+print("ref child fp_view:", hex(int(ref_v[0])))
+
+_, _, msum1 = fpr.state_fingerprints(batch1)
+msum = msum1[0]
+
+# stage 1: full expand kernel (batch 1)
+exp = kern.expand(batch1, msum1)
+print("S1 full expand fp:", hex(int(np.asarray(exp.fp_view)[0, SLOT])),
+      "valid", bool(np.asarray(exp.valid)[0, SLOT]))
+
+# stage 2: single-family expand, jitted alone
+f2 = jax.jit(lambda st, ms: kern._family_expand(fn, jnp.asarray(coords_np), st, ms))
+out2 = f2(st1, msum)
+print("S2 family expand fp:", hex(int(np.asarray(out2[2])[w])))
+
+# stage 3: single-witness, jitted: action + features + hash
+def one(st, ms):
+    valid, mult, ch, added, abort = fn(st, cw)
+    feats = fpr.spec.features(ch)
+    from tla_raft_tpu.ops.successor import _bit_get
+
+    live = (added >= 0) & ~jax.vmap(lambda i: _bit_get(st.msgs, i))(added)
+    fv, ff = fpr.child_fingerprints(feats, ms, added, live)
+    return fv, feats
+
+fv3, feats3 = jax.jit(one)(st1, msum)
+print("S3 single-slot fp:", hex(int(fv3)))
+
+# stage 4: features computed in jit, hash outside (eager)
+feats4 = jax.jit(lambda st: fpr.spec.features(fn(st, cw)[2]))(st1)
+ref_feats = fpr.spec.features_np(child_arrs)[0]
+diff = np.nonzero(np.asarray(feats4).astype(np.int64) != ref_feats)[0]
+print("S4 feats-in-jit mismatch positions:", diff[:20],
+      "of F =", fpr.spec.F)
+if len(diff):
+    print("   got ", np.asarray(feats4)[diff[:20]])
+    print("   want", ref_feats[diff[:20]])
+
+# stage 5: hash of CORRECT feats (numpy-fed) in jit + msum
+fv5, _ = jax.jit(
+    lambda f, ms: fpr.finalize(fpr.feat_hash(f) + ms)
+)(jnp.asarray(ref_feats, jnp.int8), msum)
+print("S5 hash-of-ref-feats fp:", hex(int(fv5)))

@@ -61,3 +61,59 @@ def test_sharded_violation_trace():
     assert "RaftCanCommt" in kind
     assert trace[0][0] == "Init"
     assert any(ci > 1 for ci in trace[-1][1].commit_index)
+
+
+def test_sharded_split_brain_abort_trace():
+    """The distributed abort path must locate the aborting parent and
+    return a genuine trace, not None (round-1 ADVICE finding)."""
+    from tla_raft_tpu.oracle.explicit import SplitBrainAbort, successors
+
+    cfg = RaftConfig(
+        n_servers=3, n_vals=1, max_election=2, max_restart=0,
+        mutations=("double-vote",),
+    )
+    want = OracleChecker(cfg).run()
+    got = ShardedChecker(cfg, make_mesh(4), cap_x=512, vcap=4096).run()
+    assert not got.ok and not want.ok
+    kind, trace = got.violation
+    assert "split brain" in kind
+    assert trace is not None and trace[0][0] == "Init"
+    assert got.level_sizes == want.level_sizes
+    for (_, a), (act, b) in zip(trace, trace[1:]):
+        assert any(ch == b for _n, _s, _d, ch in successors(cfg, a)), act
+    with pytest.raises(SplitBrainAbort):
+        successors(cfg, trace[-1][1])
+
+
+def test_sharded_checkpoint_resume(tmp_path):
+    """Stop a mesh run mid-sweep, resume from the snapshot, and land on
+    exactly the uninterrupted run's numbers (TLC -recover analog)."""
+    cfg = CFGS[0]
+    want = OracleChecker(cfg).run()
+    mesh = make_mesh(4)
+    full = ShardedChecker(cfg, mesh, cap_x=512, vcap=4096).run()
+    assert (full.ok, full.distinct) == (want.ok, want.distinct)
+
+    half = ShardedChecker(cfg, mesh, cap_x=512, vcap=4096).run(
+        max_depth=4, checkpoint_dir=str(tmp_path),
+    )
+    assert half.depth == 4
+    res = ShardedChecker(cfg, mesh, cap_x=512, vcap=4096).run(
+        resume_from=str(tmp_path / "latest.npz"),
+    )
+    assert res.ok == want.ok
+    assert res.distinct == want.distinct
+    assert res.generated == want.generated
+    assert res.depth == want.depth
+    assert res.level_sizes == want.level_sizes
+
+
+def test_sharded_checkpoint_rejects_mesh_mismatch(tmp_path):
+    cfg = CFGS[0]
+    ShardedChecker(cfg, make_mesh(4), cap_x=512, vcap=4096).run(
+        max_depth=2, checkpoint_dir=str(tmp_path),
+    )
+    with pytest.raises(ValueError, match="4-device mesh"):
+        ShardedChecker(cfg, make_mesh(2), cap_x=512, vcap=4096).run(
+            resume_from=str(tmp_path / "latest.npz"),
+        )

@@ -39,7 +39,7 @@ def test_skeleton_extraction():
         # swap the live FollowerAcceptEntry for the dead monolithic variant
         (lambda s: s.replace("\\/ FollowerAcceptEntry(s)", "\\/ FollowerAppendEntry(s)"), "Next disjuncts"),
         # change the checked invariant binding
-        (lambda s: s.replace("Inv ==\n    /\\ LeaderHasAllCommittedEntries", "Inv ==\n    /\\ NoSplitVote"), "Inv binds"),
+        (lambda s: s.replace("Inv ==\n  LeaderHasAllCommittedEntries", "Inv ==\n  NoSplitVote"), "Inv binds"),
         # drop msgs from the VIEW projection
         (lambda s: s.replace("msgs, role>>", "role>>"), "VIEW projection"),
     ],

@@ -114,15 +114,22 @@ def main(argv=None) -> int:
     p.add_argument("--workers", type=int, default=None,
                    help="accepted for myrun.sh compatibility; ignored")
     p.add_argument("--max-depth", type=int, default=None)
-    p.add_argument("--chunk", type=int, default=512)
+    p.add_argument("--chunk", type=int, default=1024)
     p.add_argument("--invariant", action="append", default=None,
                    help="override INVARIANT (repeatable; ~Name negates)")
     p.add_argument("--no-symmetry", action="store_true")
     p.add_argument("--no-view", action="store_true")
+    p.add_argument("--mutate", action="append", default=None,
+                   choices=("median-bug",),
+                   help="compile in a planted spec bug (SURVEY §4.4; the "
+                        "checker must then find an Inv violation)")
     p.add_argument("--servers", type=int, default=None, help="override |Servers|")
     p.add_argument("--vals", type=int, default=None, help="override |Vals|")
     p.add_argument("--max-election", type=int, default=None)
     p.add_argument("--max-restart", type=int, default=None)
+    p.add_argument("--fpstore-dir", default=None,
+                   help="use the native external-memory fingerprint store "
+                        "(TLC's states/ spill analog) rooted at this dir")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=1)
     p.add_argument("--recover", default=None, help="resume from a checkpoint .npz")
@@ -146,6 +153,8 @@ def main(argv=None) -> int:
         overrides["symmetry"] = False
     if args.no_view:
         overrides["use_view"] = False
+    if args.mutate:
+        overrides["mutations"] = tuple(args.mutate)
     if args.servers is not None:
         overrides["n_servers"] = args.servers
     if args.vals is not None:
@@ -210,15 +219,30 @@ def main(argv=None) -> int:
             )
             out.flush()
 
+        host_store = None
+        if args.fpstore_dir:
+            from .native import HostFPStore
+
+            host_store = HostFPStore(args.fpstore_dir)
+            print(f"Native FP store: {args.fpstore_dir}", file=out)
+
         if args.mesh:
             from .parallel import ShardedChecker, make_mesh
 
             res = ShardedChecker(
                 cfg, make_mesh(args.mesh), cap_x=args.cap_x,
                 exchange=args.exchange, progress=progress,
-            ).run(max_depth=args.max_depth)
+            ).run(
+                max_depth=args.max_depth,
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every,
+                resume_from=args.recover,
+            )
         else:
-            res = JaxChecker(cfg, chunk=args.chunk, progress=progress).run(
+            res = JaxChecker(
+                cfg, chunk=args.chunk, progress=progress,
+                host_store=host_store,
+            ).run(
                 max_depth=args.max_depth,
                 checkpoint_dir=args.checkpoint_dir,
                 checkpoint_every=args.checkpoint_every,
@@ -232,10 +256,20 @@ def main(argv=None) -> int:
     else:
         kind, trace = res.violation
         print(f"Error: {kind}.", file=out)
-        print_trace(cfg, trace, out)
+        if trace is not None:
+            print_trace(cfg, trace, out)
     print(
         f"{res.generated} states generated, {res.distinct} distinct states "
         f"found, depth {res.depth}.",
+        file=out,
+    )
+    # TLC prints the odds its 64-bit fingerprint set silently collided; the
+    # rebuild dedups on the same 64-bit-universe hash, so report the same
+    # birthday bound: E[collisions] ~= n(n-1)/2^65 (myrun.sh raft.log contract)
+    coll = res.distinct * max(res.distinct - 1, 0) / 2.0**65
+    print(
+        f"The probability of a fingerprint collision is calculated to be "
+        f"{coll:.3g}.",
         file=out,
     )
     if args.coverage and res.action_counts:

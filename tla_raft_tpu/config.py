@@ -57,6 +57,12 @@ class RaftConfig:
       invariants: names of INVARIANT predicates to check (Raft.cfg:33-34).
       max_term_cfg: the vestigial ``MaxTerm`` value if present (Raft.cfg:2);
         recorded for cfg fidelity, never used.
+      mutations: planted semantic bugs to compile in (SURVEY.md §4.4 —
+        the reference keeps buggy variants in comments as checker tests).
+        Known: "median-bug" — FindMedian's deliberate off-by-one
+        (``pos == Len(mlist) \\div 2`` on the descending-sorted list,
+        Raft.tla:65-66): commits at one order statistic above the
+        majority median, an over-commit the checker must catch.
     """
 
     n_servers: int = 3
@@ -67,6 +73,7 @@ class RaftConfig:
     use_view: bool = True
     invariants: tuple[str, ...] = ("Inv",)
     max_term_cfg: int | None = None
+    mutations: tuple[str, ...] = ()
 
     # ---- derived static bounds ------------------------------------------
 
@@ -103,6 +110,21 @@ class RaftConfig:
     def majority(self) -> int:
         """MajoritySize == Cardinality(Servers) \\div 2 + 1 (Raft.tla:41)."""
         return self.n_servers // 2 + 1
+
+    @property
+    def median_index(self) -> int:
+        """0-based index into the ascending-sorted matchIndex row that
+        LeaderCanCommit commits at (Raft.tla:406).
+
+        Correct Median (Raft.tla:70-75): the MajoritySize-th smallest.
+        Under the planted "median-bug" mutation (descending-list
+        ``pos == Len \\div 2`` instead of ``\\div 2 + 1``, Raft.tla:65-66)
+        the picked order statistic shifts one higher — e.g. the *maximum*
+        matchIndex for 3 servers, committing entries replicated nowhere.
+        """
+        if "median-bug" in self.mutations:
+            return self.majority
+        return self.majority - 1
 
     @property
     def n_perms(self) -> int:

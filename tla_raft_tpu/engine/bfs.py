@@ -7,18 +7,31 @@ reconstruction and checkpointing of the external TLC jar driven by
 
 * a **frontier** of full states held as padded struct-of-array tensors,
 * the successor kernel's masked fan-out (ops/successor.py) run in chunks,
-* **dedup** by sorted fingerprints: one lexsort per level over the
-  (fp_view, fp_full, payload) candidate triple picks a canonical
-  representative per new view fingerprint (min fp_full — the
-  deterministic refinement of TLC's first-writer-wins, see
-  oracle/explicit.py), then a ``searchsorted`` against the sorted
-  visited-fingerprint store filters known states,
+* **two-stage dedup**, all on device:
+    1. per chunk: sort the chunk's (fp_view, fp_full, payload) candidate
+       triples, keep the min-(fp_full, payload) representative per view
+       fingerprint (the deterministic refinement of TLC's
+       first-writer-wins — see oracle/explicit.py), drop fingerprints
+       already in the sorted visited store (``searchsorted``), and
+       compact survivors into a fixed per-chunk lane budget;
+    2. per level: one small sort over the compacted chunk survivors
+       resolves cross-chunk duplicates.
+  Stage 1 shrinks the level-wide sort from |frontier|*K dense lanes to
+  a few thousand survivors per chunk — the difference between sorting
+  ~10^8 and ~10^6 keys per level at full scale.
 * **materialization** of only the surviving (parent, slot) pairs,
 * batched invariant kernels (engine/invariants.py) on each new level,
 * per-level (parent, slot) spill to the host for counterexample traces
   (SURVEY.md §3.4: TLC's predecessor-chain walk),
 * per-level snapshots for checkpoint/resume (SURVEY.md §3.5: TLC's
   ``states/`` metadir + ``-recover``).
+
+Host/device discipline: the chunk loop runs with **zero host syncs**
+(the split-brain abort flag and per-slot multiplicities accumulate on
+device); each level fetches one small stats bundle (new-state count,
+abort/overflow flags, generated count) and the (parent, slot) trace
+spill.  Round 1 synced the abort flag per chunk, serializing host and
+device every 256 states (ADVICE.md round 1).
 
 Deadlock states (no action enabled) are not reported, matching the
 ``-deadlock`` flag in myrun.sh:3 which *disables* deadlock checking.
@@ -30,6 +43,7 @@ dtyped (u8 state, u64 fingerprints, i64 payloads).
 
 from __future__ import annotations
 
+import functools
 import os
 import time
 from typing import Callable, NamedTuple
@@ -40,13 +54,14 @@ import numpy as np
 
 from ..config import RaftConfig
 from ..models.raft import RaftState, init_batch, to_oracle
-from ..ops.fingerprint import FP_SENTINEL
 from ..ops.successor import SuccessorKernel, get_kernel
 from .invariants import resolve_invariant_kernel
 
 U64 = jnp.uint64
 I64 = jnp.int64
+I32 = jnp.int32
 SENT = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+BIG = jnp.int64(1 << 62)
 
 
 class CheckResult(NamedTuple):
@@ -85,27 +100,50 @@ def _pad_tree(st: RaftState, cap: int) -> RaftState:
     return jax.tree.map(lambda x: _pad_axis0(x, cap), st)
 
 
-@jax.jit
-def _dedup(fps_view, fps_full, payload, visited):
-    """Level dedup: sort candidates, pick representatives, drop seen.
+@functools.partial(jax.jit, static_argnames=("cap_x",))
+def _chunk_dedup(fps_view, fps_full, payload, visited, cap_x: int):
+    """Stage-1 dedup for one chunk's dense fan-out.
 
-    fps_view/full u64[C] (SENT where invalid), payload i64[C] = parent*K+slot,
-    visited u64[V] sorted ascending with SENT padding.  Returns
-    (n_new, new_fps u64[C] view-sorted then SENT-padded, new_payload i64[C]).
+    fps_view/full u64[C] (SENT where invalid), payload i64[C] (global
+    parent*K+slot), visited u64[V] sorted ascending with SENT padding.
+    Returns (n_kept i64, cv u64[cap_x], cf u64[cap_x], cp i64[cap_x],
+    overflow bool) — survivors compacted into cap_x lanes, SENT-padded.
     """
     order = jnp.lexsort((payload, fps_full, fps_view))
-    sv = fps_view[order]
+    sv, sf, sp = fps_view[order], fps_full[order], payload[order]
     first = jnp.concatenate([jnp.ones((1,), bool), sv[1:] != sv[:-1]])
     pos = jnp.searchsorted(visited, sv)
     hit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == sv
-    new = first & (sv != SENT) & ~hit
+    keep = first & (sv != SENT) & ~hit
+    n_kept = keep.sum()
+    comp = jnp.argsort(~keep, stable=True)[:cap_x]
+    lane = jnp.arange(cap_x) < n_kept
+    return (
+        n_kept.astype(I64),
+        jnp.where(lane, sv[comp], SENT),
+        jnp.where(lane, sf[comp], SENT),
+        jnp.where(lane, sp[comp], -1),
+        n_kept > cap_x,
+    )
+
+
+@jax.jit
+def _level_dedup(cv, cf, cp):
+    """Stage-2 dedup across chunk survivors (already visited-filtered).
+
+    Returns (n_new, new_fps u64[C] view-sorted SENT-padded, payload i64[C]).
+    """
+    order = jnp.lexsort((cp, cf, cv))
+    sv = cv[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), sv[1:] != sv[:-1]])
+    new = first & (sv != SENT)
     n_new = new.sum()
     comp = jnp.argsort(~new, stable=True)
     keep = jnp.arange(sv.shape[0]) < n_new
     return (
         n_new,
         jnp.where(keep, sv[comp], SENT),
-        jnp.where(keep, payload[order][comp], -1),
+        jnp.where(keep, cp[order][comp], -1),
     )
 
 
@@ -121,13 +159,15 @@ class JaxChecker:
     Parameters:
       chunk: max parents expanded per kernel launch (memory knob; the
         per-launch working set is ~chunk * K * (F + hash) bytes).
+      cap_x: per-chunk compacted-survivor lanes (grows on overflow).
       progress: optional callable(level_stats_dict) for per-level logging.
     """
 
     def __init__(
         self,
         cfg: RaftConfig,
-        chunk: int = 512,
+        chunk: int = 1024,
+        cap_x: int | None = None,
         progress: Callable[[dict], None] | None = None,
         host_store=None,
     ):
@@ -136,6 +176,9 @@ class JaxChecker:
         self.fpr = self.kern.fpr
         self.K = self.kern.K
         self.chunk = chunk
+        # frontiers roughly double per level, so a chunk's ~chunk*2 new
+        # states (plus slack for multiplicity spikes) fit 8*chunk lanes
+        self.cap_x = cap_x or 8 * chunk
         self.progress = progress
         # optional native external-memory visited store (native/fpstore.cpp);
         # when set, the device keeps no visited table at all — the level's
@@ -145,6 +188,8 @@ class JaxChecker:
             (n, resolve_invariant_kernel(n)) for n in cfg.invariants
         ]
         self._gather_mat = jax.jit(self._gather_materialize)
+        self._expand_chunk = jax.jit(self._expand_chunk_impl)
+        self._inv_scan = jax.jit(self._inv_scan_impl)
 
     # -- device helpers ----------------------------------------------------
 
@@ -153,6 +198,40 @@ class JaxChecker:
         children = self.kern.materialize(parents, slots)
         msum = self.fpr.msg_hash(children.msgs)
         return children, msum
+
+    def _expand_chunk_impl(self, part: RaftState, msum_part, start, n_f, visited):
+        """One chunk: expand + mask + stage-1 dedup, no host syncs.
+
+        start/n_f are device i64 scalars so chunk position doesn't force
+        a recompile.  Returns compacted survivors + chunk stats.
+        """
+        K = self.K
+        cap = part.voted_for.shape[0]
+        exp = self.kern.expand(part, msum_part)
+        in_range = (start + jnp.arange(cap, dtype=I64) < n_f)[:, None]
+        valid = exp.valid & in_range
+        fpv = jnp.where(valid, exp.fp_view, SENT).ravel()
+        fpf = jnp.where(valid, exp.fp_full, SENT).ravel()
+        base = ((start + jnp.arange(cap, dtype=I64)) * K)[:, None]
+        payload = (base + jnp.arange(K, dtype=I64)[None]).ravel()
+        mult_slots = jnp.where(valid, exp.mult, 0).astype(I64).sum(0)
+        ab = exp.abort & in_range[:, 0]
+        abort_at = jnp.where(
+            ab.any(), start + jnp.argmax(ab).astype(I64), BIG
+        )
+        n_kept, cv, cf, cp, overflow = _chunk_dedup(
+            fpv, fpf, payload, visited, self.cap_x
+        )
+        return cv, cf, cp, mult_slots, abort_at, overflow
+
+    def _inv_scan_impl(self, children: RaftState, n_valid):
+        """All configured invariants over a level; (first_bad_idx|-1)."""
+        N = children.voted_for.shape[0]
+        in_range = jnp.arange(N, dtype=I64) < n_valid
+        bad = jnp.zeros(N, bool)
+        for _name, fn in self.inv_fns:
+            bad = bad | (~fn(self.cfg, children, self.kern.tables) & in_range)
+        return jnp.where(bad.any(), jnp.argmax(bad).astype(I64), -1)
 
     def _action_counts(self, mult_per_slot: np.ndarray) -> dict:
         """Fold per-slot fired-transition counts to action names (the TLC
@@ -163,16 +242,13 @@ class JaxChecker:
             out[name] = out.get(name, 0) + int(mult_per_slot[fam == fi].sum())
         return {k: v for k, v in out.items() if v}
 
-    def _check_invariants(self, children: RaftState, n_valid: int):
-        """Returns (all_ok, first_bad_index, bad_name) on the host."""
-        N = children.voted_for.shape[0]
-        in_range = np.arange(N) < n_valid
+    def _bad_invariant_name(self, children: RaftState, idx: int) -> str:
+        """Identify which invariant a known-bad state violates (cold path)."""
+        one = jax.tree.map(lambda x: x[idx : idx + 1], children)
         for name, fn in self.inv_fns:
-            ok = np.asarray(fn(self.cfg, children, self.kern.tables))
-            bad = in_range & ~ok
-            if bad.any():
-                return False, int(np.nonzero(bad)[0][0]), name
-        return True, -1, None
+            if not bool(np.asarray(fn(self.cfg, one, self.kern.tables))[0]):
+                return name
+        return self.inv_fns[0][0]
 
     # -- trace reconstruction ---------------------------------------------
 
@@ -247,6 +323,43 @@ class JaxChecker:
 
     # -- the main loop -----------------------------------------------------
 
+    def _expand_level(self, frontier, msum, n_f, visited):
+        """Expand all chunks; returns device arrays + one fused host fetch."""
+        cap_f = frontier.voted_for.shape[0]
+        n_f_dev = jnp.asarray(n_f, I64)
+        cvs, cfs, cps = [], [], []
+        mult_acc = jnp.zeros((self.K,), I64)
+        abort_at = BIG
+        overflow = jnp.zeros((), bool)
+        for start in range(0, min(cap_f, _pow2(max(n_f, 1))), self.chunk):
+            part = jax.tree.map(
+                lambda x: jax.lax.dynamic_slice_in_dim(
+                    x, start, min(self.chunk, cap_f - start), 0
+                ),
+                frontier,
+            )
+            cv, cf, cp, mult_slots, ab_at, ovf = self._expand_chunk(
+                part,
+                msum[start : start + self.chunk],
+                jnp.asarray(start, I64),
+                n_f_dev,
+                visited,
+            )
+            cvs.append(cv)
+            cfs.append(cf)
+            cps.append(cp)
+            mult_acc = mult_acc + mult_slots
+            abort_at = jnp.minimum(abort_at, ab_at)
+            overflow = overflow | ovf
+        n_new_dev, new_fps, new_payload = _level_dedup(
+            jnp.concatenate(cvs), jnp.concatenate(cfs), jnp.concatenate(cps)
+        )
+        # ONE host sync for the level's control state
+        n_new, ab, ovf, mult_np = jax.device_get(
+            (n_new_dev, abort_at, overflow, mult_acc)
+        )
+        return int(n_new), new_fps, new_payload, int(ab), bool(ovf), mult_np
+
     def run(
         self,
         max_depth: int | None = None,
@@ -284,57 +397,48 @@ class JaxChecker:
             trace_levels = []
             mult_per_slot = np.zeros(K, np.int64)
 
-            ok, bad_idx, bad_name = self._check_invariants(frontier, 1)
-            if not ok:
+            bad0 = int(np.asarray(self._inv_scan(frontier, jnp.asarray(1, I64))))
+            if bad0 >= 0:
+                name0 = self._bad_invariant_name(frontier, bad0)
                 return CheckResult(
                     False, 1, 0, 0, (1,),
                     (
-                        f"Invariant {bad_name} is violated",
+                        f"Invariant {name0} is violated",
                         self._trace(trace_levels, 0, 0),
                     ),
                 )
+        # pad the resumed/initial frontier to at least one chunk so the
+        # expand kernel compiles at the chunk shape only
+        if frontier.voted_for.shape[0] < self.chunk:
+            frontier = _pad_tree(frontier, self.chunk)
+            msum = _pad_axis0(msum, self.chunk)
 
         while n_f > 0:
             if max_depth is not None and depth >= max_depth:
                 break
-            # --- expand the frontier in chunks, collect fingerprints ----
-            cap_f = frontier.voted_for.shape[0]
-            views, fulls, payloads, mults = [], [], [], []
-            abort_at = -1
-            for start in range(0, cap_f, self.chunk):
-                stop = min(start + self.chunk, cap_f)
-                part = jax.tree.map(lambda x: x[start:stop], frontier)
-                exp = self.kern.expand(part, msum[start:stop])
-                in_range = (jnp.arange(start, stop) < n_f)[:, None]
-                valid = exp.valid & in_range
-                views.append(jnp.where(valid, exp.fp_view, SENT).ravel())
-                fulls.append(jnp.where(valid, exp.fp_full, SENT).ravel())
-                base = (jnp.arange(start, stop, dtype=I64) * K)[:, None]
-                payloads.append((base + jnp.arange(K, dtype=I64)[None]).ravel())
-                mults.append(jnp.where(valid, exp.mult, 0).astype(I64).sum(0))
-                ab = np.asarray(exp.abort & in_range[:, 0])
-                if ab.any():
-                    abort_at = start + int(np.nonzero(ab)[0][0])
+            # --- expand + two-stage dedup (device), fused level fetch ----
+            while True:
+                (n_new, new_fps, new_payload, abort_at, overflow, level_mult
+                 ) = self._expand_level(frontier, msum, n_f, visited)
+                if not overflow:
                     break
-            if abort_at >= 0:
+                # a chunk kept more survivors than its lane budget: grow
+                # and redo the level (pure computation, rare).  cap_x is
+                # baked into the traced program, so re-jit.
+                self.cap_x *= 2
+                self._expand_chunk = jax.jit(self._expand_chunk_impl)
+            if abort_at < n_f:
                 return CheckResult(
                     False, distinct, generated, depth, tuple(level_sizes),
                     (
                         'Assert "split brain" (Raft.tla:185)',
                         self._trace(trace_levels, depth, abort_at),
                     ),
-                    self._action_counts(mult_per_slot),
+                    self._action_counts(mult_per_slot + level_mult),
                 )
-            fps_view = jnp.concatenate(views)
-            fps_full = jnp.concatenate(fulls)
-            payload = jnp.concatenate(payloads)
-            level_mult = np.sum([np.asarray(m) for m in mults], axis=0)  # [K]
-            mult_per_slot += level_mult
+            mult_per_slot = mult_per_slot + level_mult
             generated += int(level_mult.sum())
 
-            # --- dedup against visited + within level -------------------
-            n_new_dev, new_fps, new_payload = _dedup(fps_view, fps_full, payload, visited)
-            n_new = int(n_new_dev)
             if self.host_store is not None and n_new:
                 fps_np = np.asarray(new_fps[:n_new])
                 is_new = self.host_store.insert(fps_np)
@@ -361,7 +465,9 @@ class JaxChecker:
             level_sizes.append(n_new)
             depth += 1
 
-            ok, bad_idx, bad_name = self._check_invariants(children, n_new)
+            bad_idx = int(
+                np.asarray(self._inv_scan(children, jnp.asarray(n_new, I64)))
+            )
 
             if self.host_store is None:
                 # merge, then trim the store to a pow2 capacity >= distinct
@@ -386,11 +492,12 @@ class JaxChecker:
                     visited, n_f, distinct, generated, depth, level_sizes,
                     trace_levels, mult_per_slot,
                 )
-            if not ok:
+            if bad_idx >= 0:
+                name = self._bad_invariant_name(children, bad_idx)
                 return CheckResult(
                     False, distinct, generated, depth, tuple(level_sizes),
                     (
-                        f"Invariant {bad_name} is violated",
+                        f"Invariant {name} is violated",
                         self._trace(trace_levels, depth, bad_idx),
                     ),
                     self._action_counts(mult_per_slot),

@@ -281,11 +281,19 @@ class SuccessorKernel:
         qual = self.tables.vq_uptodate[cand, s, jnp.clip(cur - 1, 0, None), llt, ll - 1]
         vf = st.voted_for.astype(I32)[s]
         grant = uni.encode_voteresp(s + 1, cand + 1, jnp.clip(cur, 1, None)).astype(I32)
+        # the "double-vote" mutation drops the votedFor guard (a classic
+        # Raft bug that makes the split-brain Assert reachable — used to
+        # exercise the abort path end to end, SURVEY.md §4.4)
+        vf_ok = (
+            True
+            if "double-vote" in cfg.mutations
+            else (vf == 0) | (vf == cand + 1)
+        )
         valid = (
             (st.role[s] == FOLLOWER)
             & (cur >= 1)
             & (cand != s)
-            & ((vf == 0) | (vf == cand + 1))
+            & vf_ok
             & _any(st.msgs, qual)
             & ~_bit_get(st.msgs, grant)
         )
@@ -316,11 +324,19 @@ class SuccessorKernel:
         s, v = c[0], c[1]
         ll = st.log_len.astype(I32)[s]
         valid = (st.role[s] == LEADER) & (st.val_sent[v] == 0) & (ll < L)
-        w = jnp.clip(ll, 0, L - 1)  # append position (0-based TLA index ll+1)
+        # append position (0-based TLA index ll+1), written as an iota-mask
+        # select: a scatter whose index depends on state data (not the
+        # witness grid) miscompiles on XLA:TPU at large batch shapes —
+        # cross-row contamination, caught by the oracle differential.
+        at_w = jnp.arange(L, dtype=I32) == jnp.clip(ll, 0, L - 1)
         child = st._replace(
             val_sent=st.val_sent.at[v].set(U8(1)),  # := FALSE, Raft.tla:237
-            log_term=st.log_term.at[s, w].set(st.current_term[s]),
-            log_val=st.log_val.at[s, w].set((v + 1).astype(U8)),
+            log_term=st.log_term.at[s].set(
+                jnp.where(at_w, st.current_term[s], st.log_term[s])
+            ),
+            log_val=st.log_val.at[s].set(
+                jnp.where(at_w, (v + 1).astype(U8), st.log_val[s])
+            ),
             log_len=st.log_len.at[s].set((ll + 1).astype(U8)),
             match_index=st.match_index.at[s, s].set((ll + 1).astype(U8)),
         )
@@ -457,7 +473,7 @@ class SuccessorKernel:
         cfg = self.cfg
         s = c[0]
         row = jnp.sort(st.match_index.astype(I32)[s])
-        med = row[cfg.majority - 1]  # Median(F), Raft.tla:70-75
+        med = row[cfg.median_index]  # Median(F), Raft.tla:70-75 (or mutation)
         valid = (st.role[s] == LEADER) & (med > st.commit_index.astype(I32)[s])
         child = st._replace(commit_index=st.commit_index.at[s].set(med.astype(U8)))
         return valid, I32(1), child, self._no_add(), False

@@ -183,7 +183,7 @@ def response_vote(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState,
         if m[0] != VOTE_REQ or m[2] != s or m[3] != cur:
             continue
         src = m[1]
-        if st.voted_for[s - 1] not in (NONE, src):
+        if "double-vote" not in cfg.mutations and st.voted_for[s - 1] not in (NONE, src):
             continue
         m_lli, m_llt = m[4], m[5]
         up_to_date = (m_llt > my_llt) or (m_llt == my_llt and m_lli >= my_lli)
@@ -371,8 +371,10 @@ def handle_append_resp(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OS
 
 
 def _median(cfg: RaftConfig, row: tuple[int, ...]) -> int:
-    """Median(F) — Raft.tla:70-75: the MajoritySize-th smallest value."""
-    return sorted(row)[cfg.majority - 1]
+    """Median(F) — Raft.tla:70-75: the MajoritySize-th smallest value
+    (or the planted FindMedian off-by-one under the median-bug mutation,
+    Raft.tla:65-66 — see RaftConfig.median_index)."""
+    return sorted(row)[cfg.median_index]
 
 
 def leader_can_commit(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:

@@ -36,6 +36,7 @@ like the single-device engine.
 from __future__ import annotations
 
 import functools
+import os
 import time
 from typing import NamedTuple
 
@@ -77,7 +78,9 @@ class LevelOut(NamedTuple):
     inv_bad: jnp.ndarray  # i32[] psum'd violation count this level
     inv_bad_at: jnp.ndarray  # i64[1] local index of first violation or -1
     abort: jnp.ndarray  # bool[] any split-brain abort (psum'd)
-    overflow: jnp.ndarray  # bool[] a capacity was exceeded -> retry bigger
+    abort_at: jnp.ndarray  # i64[1] local frontier index of first abort or -1
+    overflow_x: jnp.ndarray  # bool[] candidate/routing capacity exceeded
+    overflow_v: jnp.ndarray  # bool[] visited-shard capacity exceeded
 
 
 class CheckResult(NamedTuple):
@@ -151,9 +154,11 @@ class ShardedChecker:
         mult_slots = jax.lax.psum(
             jnp.where(valid, exp.mult, 0).astype(I64).sum(0), "d"
         )
-        abort = (
-            jax.lax.psum((exp.abort & in_range[:, 0]).any().astype(I32), "d") > 0
-        )
+        abort_local = exp.abort & in_range[:, 0]
+        abort = jax.lax.psum(abort_local.any().astype(I32), "d") > 0
+        abort_at = jnp.where(
+            abort_local.any(), jnp.argmax(abort_local), -1
+        ).astype(I64)
 
         # local pre-dedup: min (fp_full, payload) representative per view fp
         order = jnp.lexsort((payload, fpf, fpv))
@@ -164,7 +169,7 @@ class ShardedChecker:
         cv, cf, cp, _lane = _compact(
             keep, self.cap_x, sv, sf, sp, fills=(SENT, SENT, I64(-1))
         )
-        return cv, cf, cp, mult_slots, abort, overflow, dev, cap_f
+        return cv, cf, cp, mult_slots, abort, abort_at, overflow, dev, cap_f
 
     def _children_from(self, frontier, cap_f, dev, wpay, wlane):
         """Materialize chosen (payload) slots locally + invariants."""
@@ -191,8 +196,8 @@ class ShardedChecker:
         return children, child_msum, gpidx, slots, inv_bad, first_bad
 
     def _body_all_gather(self, frontier, msum, n_f, visited):
-        cv, cf, cp, mult_slots, abort, overflow, dev, cap_f = self._expand_local(
-            frontier, msum, n_f
+        (cv, cf, cp, mult_slots, abort, abort_at, overflow, dev, cap_f) = (
+            self._expand_local(frontier, msum, n_f)
         )
         pos = jnp.searchsorted(visited, cv)
         hit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == cv
@@ -220,16 +225,17 @@ class ShardedChecker:
             n_new_local[None], n_new_total,
             mult_slots.sum(), mult_slots,
             gpidx, jnp.where(wlane, slots, -1),
-            inv_bad, first_bad[None], abort,
+            inv_bad, first_bad[None], abort, abort_at[None],
             jax.lax.psum(overflow.astype(I32), "d") > 0,
+            jnp.zeros((), bool),
         )
 
     def _body_all_to_all(self, frontier, msum, n_f, visited):
         """Owner-sharded dedup: fp % D owns; candidates route via all_to_all."""
         D, cap_x = self.D, self.cap_x
         cap_r = self.cap_r  # per-(src,dst) routing capacity
-        cv, cf, cp, mult_slots, abort, overflow, dev, cap_f = self._expand_local(
-            frontier, msum, n_f
+        (cv, cf, cp, mult_slots, abort, abort_at, overflow, dev, cap_f) = (
+            self._expand_local(frontier, msum, n_f)
         )
         # --- route to owners ---------------------------------------------
         # sentinel lanes route to a virtual discard row D so they neither
@@ -240,7 +246,7 @@ class ShardedChecker:
         counts = jnp.bincount(oo, length=D + 1)
         starts = jnp.cumsum(counts) - counts
         rank = jnp.arange(cap_x) - starts[oo]
-        overflow = overflow | (counts[:D].max() > cap_r)
+        overflow_x = overflow | (counts[:D].max() > cap_r)
         # scatter into the [D+1, cap_r] send buffer; slice off the discard row
         sendv = jnp.full((D + 1, cap_r), SENT, U64)
         sendf = jnp.full((D + 1, cap_r), SENT, U64)
@@ -265,7 +271,7 @@ class ShardedChecker:
         n_own_new = qnew.sum()
         # update the shard (sorted merge, fixed capacity)
         vcount = (visited != SENT).sum()
-        overflow = overflow | (vcount + n_own_new > visited.shape[0])
+        overflow_v = vcount + n_own_new > visited.shape[0]
         upd = jnp.sort(
             jnp.concatenate([visited, jnp.where(qnew, qsv, SENT)])
         )[: visited.shape[0]]
@@ -288,8 +294,9 @@ class ShardedChecker:
             n_new_local[None], n_new_total,
             mult_slots.sum(), mult_slots,
             gpidx, jnp.where(wlane, slots, -1),
-            inv_bad, first_bad[None], abort,
-            jax.lax.psum(overflow.astype(I32), "d") > 0,
+            inv_bad, first_bad[None], abort, abort_at[None],
+            jax.lax.psum(overflow_x.astype(I32), "d") > 0,
+            jax.lax.psum(overflow_v.astype(I32), "d") > 0,
         )
 
     @functools.cached_property
@@ -315,7 +322,7 @@ class ShardedChecker:
                 out_specs=LevelOut(
                     jax.tree.map(lambda _: P("d"), init_batch(self.cfg, 1)),
                     P("d"), vspec, P("d"), P(), P(), P(),
-                    P("d"), P("d"), P(), P("d"), P(), P(),
+                    P("d"), P("d"), P(), P("d"), P(), P("d"), P(), P(),
                 ),
                 # the scatter-in-switch inside materialize trips the vma
                 # (varying-axis) type checker; the body is plain SPMD with
@@ -352,45 +359,131 @@ class ShardedChecker:
             out[name] = out.get(name, 0) + int(mult_slots[fam == fi].sum())
         return {k: v for k, v in out.items() if v}
 
+    # -- checkpoint / resume (TLC's states/ + -recover, mesh edition) ------
+
+    def _save_checkpoint(self, path, frontier, msum, n_f, visited, distinct,
+                         generated, depth, level_sizes, trace_levels,
+                         mult_slots_total):
+        arrs = {f"st_{k}": np.asarray(v) for k, v in frontier._asdict().items()}
+        for i, (p, s) in enumerate(trace_levels):
+            arrs[f"trace_p{i}"] = p
+            arrs[f"trace_s{i}"] = s
+        tmp = f"{path}.tmp.npz"
+        np.savez_compressed(
+            tmp,
+            msum=np.asarray(msum),
+            n_f=np.asarray(n_f),
+            visited=np.asarray(visited),
+            mult_slots=mult_slots_total,
+            meta=np.asarray(
+                [self.D, distinct, generated, depth,
+                 1 if self.exchange == "all_to_all" else 0],
+                np.int64,
+            ),
+            level_sizes=np.asarray(level_sizes, np.int64),
+            n_trace=np.asarray([len(trace_levels)], np.int64),
+            **arrs,
+        )
+        os.replace(tmp, path)
+
+    def _load_checkpoint(self, path, shard, repl):
+        z = np.load(path)
+        D, distinct, generated, depth, a2a = (int(x) for x in z["meta"])
+        if D != self.D:
+            raise ValueError(
+                f"checkpoint was taken on a {D}-device mesh, this run has "
+                f"{self.D} (fingerprint ownership is D-dependent)"
+            )
+        if a2a != (1 if self.exchange == "all_to_all" else 0):
+            raise ValueError("checkpoint exchange mode differs from this run")
+        frontier = RaftState(
+            **{
+                k[3:]: jax.device_put(jnp.asarray(z[k]), shard)
+                for k in z.files
+                if k.startswith("st_")
+            }
+        )
+        visited = jax.device_put(
+            jnp.asarray(z["visited"]),
+            shard if self.exchange == "all_to_all" else repl,
+        )
+        if self.exchange == "all_to_all":
+            self.vcap = z["visited"].shape[0] // D
+        else:
+            self.vcap = z["visited"].shape[0]
+        trace_levels = [
+            (z[f"trace_p{i}"], z[f"trace_s{i}"])
+            for i in range(int(z["n_trace"][0]))
+        ]
+        return dict(
+            frontier=frontier,
+            msum=jax.device_put(jnp.asarray(z["msum"]), shard),
+            n_f=jax.device_put(jnp.asarray(z["n_f"]), shard),
+            visited=visited,
+            distinct=distinct,
+            generated=generated,
+            depth=depth,
+            level_sizes=list(int(x) for x in z["level_sizes"]),
+            trace_levels=trace_levels,
+            mult_slots=np.asarray(z["mult_slots"]),
+        )
+
     # -- the distributed run ----------------------------------------------
 
-    def run(self, max_depth: int | None = None) -> CheckResult:
+    def run(
+        self,
+        max_depth: int | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
+        resume_from: str | None = None,
+    ) -> CheckResult:
         cfg, D = self.cfg, self.D
         mesh = self.mesh
         shard = NamedSharding(mesh, P("d"))
         repl = NamedSharding(mesh, P())
         t0 = time.monotonic()
 
-        frontier = jax.device_put(init_batch(cfg, D), shard)
-        fv, _ff, msum = self.fpr.state_fingerprints(frontier)
-        msum = jax.device_put(msum, shard)
-        n_f = jax.device_put(jnp.asarray([1] + [0] * (D - 1), I64), shard)
-        fp0 = np.asarray(fv.astype(U64))[0]
-        if self.exchange == "all_to_all":
-            vis = np.full((D, self.vcap), np.uint64(0xFFFFFFFFFFFFFFFF))
-            vis[int(fp0 % D), 0] = fp0
-            vis = np.sort(vis, axis=1)
-            visited = jax.device_put(jnp.asarray(vis).reshape(-1), shard)
-        else:
-            vis = np.full(self.vcap, np.uint64(0xFFFFFFFFFFFFFFFF))
-            vis[0] = fp0
-            visited = jax.device_put(jnp.asarray(np.sort(vis)), repl)
-        distinct, generated, depth = 1, 0, 0
-        level_sizes = [1]
-        trace_levels: list[tuple[np.ndarray, np.ndarray]] = []
-        mult_slots_total = np.zeros(self.K, np.int64)
-
-        # init-state invariants (host-side, single state)
-        from ..engine.bfs import JaxChecker  # reuse the batched kernels
-
-        ok0, _idx, name0 = JaxChecker(cfg)._check_invariants(
-            jax.device_put(init_batch(cfg, 1), repl), 1
-        )
-        if not ok0:
-            return CheckResult(
-                False, 1, 0, 0, (1,),
-                (f"Invariant {name0} is violated", self._trace([], 0, 0)), {},
+        if resume_from is not None:
+            ck = self._load_checkpoint(resume_from, shard, repl)
+            frontier, msum, n_f = ck["frontier"], ck["msum"], ck["n_f"]
+            visited = ck["visited"]
+            distinct, generated, depth = (
+                ck["distinct"], ck["generated"], ck["depth"],
             )
+            level_sizes, trace_levels = ck["level_sizes"], ck["trace_levels"]
+            mult_slots_total = ck["mult_slots"]
+        else:
+            frontier = jax.device_put(init_batch(cfg, D), shard)
+            fv, _ff, msum0 = self.fpr.state_fingerprints(frontier)
+            msum = jax.device_put(msum0, shard)
+            n_f = jax.device_put(jnp.asarray([1] + [0] * (D - 1), I64), shard)
+            fp0 = np.asarray(fv.astype(U64))[0]
+            if self.exchange == "all_to_all":
+                vis = np.full((D, self.vcap), np.uint64(0xFFFFFFFFFFFFFFFF))
+                vis[int(fp0 % D), 0] = fp0
+                vis = np.sort(vis, axis=1)
+                visited = jax.device_put(jnp.asarray(vis).reshape(-1), shard)
+            else:
+                vis = np.full(self.vcap, np.uint64(0xFFFFFFFFFFFFFFFF))
+                vis[0] = fp0
+                visited = jax.device_put(jnp.asarray(np.sort(vis)), repl)
+            distinct, generated, depth = 1, 0, 0
+            level_sizes = [1]
+            trace_levels = []
+            mult_slots_total = np.zeros(self.K, np.int64)
+
+            # init-state invariants (host-side, single state)
+            from ..engine.bfs import JaxChecker  # reuse the batched kernels
+
+            chk0 = JaxChecker(cfg)
+            init1 = jax.device_put(init_batch(cfg, 1), repl)
+            bad0 = int(np.asarray(chk0._inv_scan(init1, jnp.asarray(1, I64))))
+            if bad0 >= 0:
+                name0 = chk0._bad_invariant_name(init1, bad0)
+                return CheckResult(
+                    False, 1, 0, 0, (1,),
+                    (f"Invariant {name0} is violated", self._trace([], 0, 0)), {},
+                )
 
         def grow_visited(v, new_vcap):
             """Pad every store shard (sorted, SENT tail) to a new capacity."""
@@ -406,24 +499,39 @@ class ShardedChecker:
                 break
             if self.exchange == "all_to_all" and distinct > D * self.vcap // 2:
                 visited = grow_visited(visited, self.vcap * 4)
-            out = self.level_step(frontier, msum, n_f, visited)
-            if bool(out.overflow):
-                if self.exchange == "all_to_all":
-                    # a shard (or routing lane) overflowed: grow and retry —
-                    # the level step is pure, so the failed outputs drop
+            # the level step is pure, so failed (overflowed) outputs drop
+            # and the retry recomputes the level at the grown capacity
+            for _retry in range(8):
+                out = self.level_step(frontier, msum, n_f, visited)
+                if bool(out.overflow_v):
                     visited = grow_visited(visited, self.vcap * 4)
-                    out = self.level_step(frontier, msum, n_f, visited)
-            if bool(out.overflow):
+                elif bool(out.overflow_x):
+                    # candidate compaction / routing lanes overflowed: grow
+                    # cap_x (recompiles the level step — rare)
+                    self.cap_x *= 2
+                    self.__dict__.pop("level_step", None)
+                    self.__dict__.pop("cap_r", None)
+                else:
+                    break
+            else:
                 raise RuntimeError(
                     f"capacity overflow at level {depth + 1} "
                     f"(cap_x={self.cap_x}, cap_r={self.cap_r}, "
-                    f"vcap={self.vcap}); re-run with larger capacities"
+                    f"vcap={self.vcap})"
                 )
             if bool(out.abort):
-                # locate the aborting parent on the host (rare path)
+                # locate the aborting parent (a current-frontier state) and
+                # replay its slot chain, exactly like the single-device path
+                bad_at = np.asarray(out.abort_at)
+                devs = np.nonzero(bad_at >= 0)[0]
+                cap_f = frontier.voted_for.shape[0] // D
+                gidx = int(devs[0]) * cap_f + int(bad_at[devs[0]])
                 return CheckResult(
                     False, distinct, generated, depth, tuple(level_sizes),
-                    ('Assert "split brain" (Raft.tla:185)', None),
+                    (
+                        'Assert "split brain" (Raft.tla:185)',
+                        self._trace(trace_levels, depth, gidx),
+                    ),
                     self._action_counts(mult_slots_total),
                 )
             mult_slots_total += np.asarray(out.mult_slots)
@@ -441,11 +549,19 @@ class ShardedChecker:
             visited = out.visited
             if self.exchange == "all_gather":
                 # the replicated store grows by D*cap_x sentinel-padded slots
-                # per level; trim it back on the host
-                keep = max(4096, 1 << (distinct + 64).bit_length())
+                # per level; trim back to the tightest pow2 that holds every
+                # distinct fingerprint (store is sorted, SENT-padded)
+                keep = max(4096, 1 << distinct.bit_length())
                 visited = jax.device_put(out.visited[:keep], repl)
             frontier, msum = out.children, out.child_msum
             n_f = jax.device_put(out.n_new_local, shard)
+            if checkpoint_dir and checkpoint_every and depth % checkpoint_every == 0:
+                os.makedirs(checkpoint_dir, exist_ok=True)
+                self._save_checkpoint(
+                    os.path.join(checkpoint_dir, "latest.npz"), frontier, msum,
+                    n_f, visited, distinct, generated, depth, level_sizes,
+                    trace_levels, mult_slots_total,
+                )
             if self.progress is not None:
                 self.progress(
                     dict(
