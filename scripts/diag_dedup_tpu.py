@@ -24,7 +24,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 import jax.numpy as jnp
 import numpy as np
 
-from tla_raft_tpu.engine.bfs import _chunk_dedup, _level_dedup
+from tla_raft_tpu.engine.bfs import _chunk_compact, _chunk_dedup, _level_dedup
 
 print("backend:", jax.default_backend())
 SENT = np.uint64(0xFFFFFFFFFFFFFFFF)
@@ -68,12 +68,13 @@ def trial(C, n_live, n_unique, vis_size, n_vis_hits, cap_x, tag):
     vis[: len(hits)] = hits
     vis = np.sort(vis)
 
-    n_dev, cv_d, cf_d, cp_d = jax.device_get(
-        _chunk_dedup(
-            jnp.asarray(fv), jnp.asarray(ff), jnp.asarray(fp),
-            jnp.asarray(vis), cap_x,
-        )[:4]
+    cv0, cf0, cp0, _ovf = _chunk_compact(
+        jnp.asarray(fv), jnp.asarray(ff), jnp.asarray(fp), cap_x
     )
+    cv_d, cf_d, cp_d = jax.device_get(
+        _chunk_dedup(cv0, cf0, cp0, jnp.asarray(vis))
+    )
+    n_dev = int((cv_d != SENT).sum())
     n_ref, cv_r, cf_r, cp_r = ref_chunk(fv, ff, fp, vis, cap_x)
     ok = (
         int(n_dev) == n_ref

@@ -1,0 +1,147 @@
+"""Per-stage timing of one BFS level on the current backend.
+
+Runs the checker to a target depth, snapshots the frontier, then times
+each stage of the next level independently (block_until_ready between
+stages): expand+stage-1 dedup per chunk, level dedup, host fetch,
+materialize, invariants, visited merge.  The numbers drive the
+host/device-discipline and sort-size optimizations (VERDICT round 1 #4).
+
+Usage: PYTHONPATH=. python scripts/profile_level.py [depth] [chunk] [--cpu]
+"""
+
+import sys
+import time
+
+depth = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+chunk = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import os
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/tla_raft_tpu_jax")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.engine.bfs import I64, _level_dedup, _merge_sorted
+
+cfg = load_raft_config("/root/reference/Raft.cfg")
+print("backend:", jax.default_backend(), "chunk:", chunk, "to depth", depth)
+
+chk = JaxChecker(cfg, chunk=chunk)
+
+# drive the engine to `depth` by hand (mirrors run()'s loop, keeps arrays)
+frontier = None
+
+
+class Capture(Exception):
+    pass
+
+
+orig = chk._expand_level
+state = {}
+
+
+def capture_expand(frontier, msum, n_f, visited):
+    state.update(frontier=frontier, msum=msum, n_f=n_f, visited=visited)
+    return orig(frontier, msum, n_f, visited)
+
+
+t0 = time.monotonic()
+res = chk.run(max_depth=depth)
+print(
+    f"warm-up run to depth {depth}: {res.level_sizes[-1]} frontier, "
+    f"{res.distinct} distinct, {time.monotonic() - t0:.1f}s"
+)
+
+chk2 = JaxChecker(cfg, chunk=chunk)
+chk2._expand_level = capture_expand.__get__(chk2)
+
+
+# re-run capturing the last level's inputs
+def cap_expand(frontier, msum, n_f, visited):
+    state.update(frontier=frontier, msum=msum, n_f=n_f, visited=visited)
+    return JaxChecker._expand_level(chk2, frontier, msum, n_f, visited)
+
+
+chk2._expand_level = cap_expand
+res2 = chk2.run(max_depth=depth)
+frontier, msum, n_f, visited = (
+    state["frontier"], state["msum"], state["n_f"], state["visited"],
+)
+print(f"captured level input: n_f={n_f}, visited cap={visited.shape[0]}")
+
+# --- stage timing ---------------------------------------------------------
+
+
+def timeit(label, fn, n=3):
+    fn()  # warm
+    t0 = time.monotonic()
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / n
+    print(f"  {label:<34} {dt * 1e3:9.1f} ms")
+    return out
+
+
+cap_f = frontier.voted_for.shape[0]
+starts = list(range(0, min(cap_f, max(n_f, 1)), chunk))
+print(f"level with {len(starts)} chunks of {chunk} (K={chk2.K}):")
+
+
+from tla_raft_tpu.engine.bfs import _chunk_dedup
+
+
+def one_chunk(start):
+    part = jax.tree.map(
+        lambda x: jax.lax.dynamic_slice_in_dim(x, start, min(chunk, cap_f - start), 0),
+        frontier,
+    )
+    cv0, cf0, cp0, mult_slots, ab, ovf = chk2._expand_chunk(
+        part, msum[start : start + chunk], jnp.asarray(start, I64),
+        jnp.asarray(n_f, I64),
+    )
+    return _chunk_dedup(cv0, cf0, cp0, visited) + (mult_slots, ab, ovf)
+
+
+timeit("one chunk (expand+dedup1)", lambda: one_chunk(0))
+
+def full_level():
+    outs = [one_chunk(s) for s in starts]
+    return outs[-1]
+
+timeit("all chunks (async pipeline)", full_level, n=1)
+
+outs = [one_chunk(s) for s in starts]
+cvs = jnp.concatenate([o[0] for o in outs])
+cfs = jnp.concatenate([o[1] for o in outs])
+cps = jnp.concatenate([o[2] for o in outs])
+jax.block_until_ready((cvs, cfs, cps))
+print(f"  level-dedup input lanes: {cvs.shape[0]}")
+timeit("level dedup (sort survivors)", lambda: _level_dedup(cvs, cfs, cps))
+n_new_dev, new_fps, new_payload = _level_dedup(cvs, cfs, cps)
+timeit("host fetch n_new", lambda: int(n_new_dev))
+n_new = int(n_new_dev)
+print(f"  n_new = {n_new}")
+pay_np = np.asarray(new_payload[:n_new])
+cap_c = max(1 << ((max(n_new - 1, 0)).bit_length() + 1) // 2 * 2, chunk)
+from tla_raft_tpu.engine.bfs import _cap4, _pad_axis0
+
+cap_c = max(_cap4(n_new), chunk)
+pidx = _pad_axis0(jnp.asarray(pay_np // chk2.K, I64), cap_c)
+slots = _pad_axis0(jnp.asarray(pay_np % chk2.K, I64), cap_c)
+timeit("materialize survivors", lambda: chk2._gather_mat(frontier, pidx, slots))
+children, child_msum = chk2._gather_mat(frontier, pidx, slots)
+timeit("invariant scan", lambda: chk2._inv_scan(children, jnp.asarray(n_new, I64)))
+timeit("visited merge", lambda: _merge_sorted(visited, new_fps))

@@ -1,0 +1,90 @@
+"""Scale config: 5-server model (the s4/s5 dial, Raft.cfg:16-17).
+
+The reference pre-declares ``s4, s5`` as the scale-up path (BASELINE.md
+configs 3-5).  These tests prove the whole stack — message universe,
+guard tables, successor kernel, fingerprints (120 server permutations),
+engine — is correct at S=5, not just built:
+
+* sampled expand/materialize differential vs the oracle on reachable
+  states at reference-like bounds,
+* full engine-vs-oracle BFS parity on a bounded 5-server space.
+"""
+
+import collections
+import dataclasses
+
+import numpy as np
+import pytest
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.models.raft import from_oracle
+from tla_raft_tpu.ops.successor import get_kernel
+from tla_raft_tpu.oracle import OracleChecker
+from tla_raft_tpu.oracle.explicit import init_state, successors
+
+
+@pytest.fixture(scope="module")
+def cfg5():
+    cfg = load_raft_config("/root/reference/Raft.cfg")
+    return dataclasses.replace(cfg, n_servers=5)
+
+
+def collect(cfg, n):
+    seen, order, frontier = {init_state(cfg)}, [init_state(cfg)], [init_state(cfg)]
+    while frontier and len(order) < n:
+        nxt = []
+        for st in frontier:
+            for _a, _s, _d, ch in successors(cfg, st):
+                if ch not in seen:
+                    seen.add(ch)
+                    order.append(ch)
+                    nxt.append(ch)
+        frontier = nxt
+    return order[:n]
+
+
+def test_universe_dimensions(cfg5):
+    kern = get_kernel(cfg5)
+    assert kern.fpr.P == 120  # 5! server permutations folded into the hash
+    assert kern.uni.M == 16080
+    assert kern.K == 1900
+
+
+def test_expand_matches_oracle_s5(cfg5):
+    """Sampled differential at full reference bounds (S=5, V=2, E=R=3)."""
+    kern = get_kernel(cfg5)
+    fpr = kern.fpr
+    states = collect(cfg5, 48)
+    batch = from_oracle(cfg5, states)
+    _, _, msum = fpr.state_fingerprints(batch)
+    exp = kern.expand(batch, msum)
+    valid = np.asarray(exp.valid)
+    mult = np.asarray(exp.mult)
+    fpv = np.asarray(exp.fp_view)
+    assert not np.asarray(exp.abort).any()
+
+    all_succs = [successors(cfg5, st) for st in states]
+    flat = [ch for ss in all_succs for _a, _s, _d, ch in ss]
+    ev, _, _ = fpr.state_fingerprints(from_oracle(cfg5, flat))
+    ev = np.asarray(ev)
+    off = 0
+    for i, succs in enumerate(all_succs):
+        assert int(mult[i][valid[i]].sum()) == len(succs), f"state {i}"
+        want = collections.Counter(ev[off : off + len(succs)].tolist())
+        off += len(succs)
+        got = collections.Counter()
+        for k in np.nonzero(valid[i])[0]:
+            got[int(fpv[i, k])] += int(mult[i, k])
+        assert got == want, f"state {i}"
+
+
+def test_engine_parity_s5(cfg5):
+    """Full BFS parity engine-vs-oracle on a bounded 5-server space."""
+    small = dataclasses.replace(cfg5, max_election=1, max_restart=0, n_vals=1)
+    o = OracleChecker(small).run(max_depth=9)
+    e = JaxChecker(small, chunk=64).run(max_depth=9)
+    assert o.ok and e.ok
+    assert e.level_sizes == o.level_sizes == (1, 1, 1, 2, 2, 3, 3, 6, 15, 36)
+    assert e.generated == o.generated
+    assert e.action_counts == o.action_counts

@@ -120,7 +120,7 @@ def main(argv=None) -> int:
     p.add_argument("--no-symmetry", action="store_true")
     p.add_argument("--no-view", action="store_true")
     p.add_argument("--mutate", action="append", default=None,
-                   choices=("median-bug",),
+                   choices=("median-bug", "double-vote"),
                    help="compile in a planted spec bug (SURVEY §4.4; the "
                         "checker must then find an Inv violation)")
     p.add_argument("--servers", type=int, default=None, help="override |Servers|")
@@ -221,6 +221,15 @@ def main(argv=None) -> int:
 
         host_store = None
         if args.fpstore_dir:
+            if args.mesh:
+                p.error("--fpstore-dir is not supported with --mesh yet "
+                        "(the distributed store is device-sharded)")
+            if args.checkpoint_dir or args.recover:
+                # the .npz checkpoint does not snapshot the on-disk store,
+                # so a resumed run would see its own pre-crash inserts as
+                # already-visited and report a truncated clean sweep
+                p.error("--fpstore-dir cannot be combined with "
+                        "--checkpoint-dir/--recover yet")
             from .native import HostFPStore
 
             host_store = HostFPStore(args.fpstore_dir)

@@ -101,29 +101,51 @@ def _pad_tree(st: RaftState, cap: int) -> RaftState:
 
 
 @functools.partial(jax.jit, static_argnames=("cap_x",))
-def _chunk_dedup(fps_view, fps_full, payload, visited, cap_x: int):
-    """Stage-1 dedup for one chunk's dense fan-out.
+def _chunk_compact(fps_view, fps_full, payload, cap_x: int):
+    """Compact one chunk's valid fan-out lanes into cap_x lanes (no dedup).
 
     fps_view/full u64[C] (SENT where invalid), payload i64[C] (global
-    parent*K+slot), visited u64[V] sorted ascending with SENT padding.
-    Returns (n_kept i64, cv u64[cap_x], cf u64[cap_x], cp i64[cap_x],
-    overflow bool) — survivors compacted into cap_x lanes, SENT-padded.
+    parent*K+slot).  A stable bool-key argsort moves the ~0.5%-dense valid
+    lanes to the front — far cheaper than sorting C u64 triples, and it
+    keeps the visited store out of this (large, shape-stable) program so
+    store growth never recompiles the expand kernel.
     """
-    order = jnp.lexsort((payload, fps_full, fps_view))
-    sv, sf, sp = fps_view[order], fps_full[order], payload[order]
+    live = fps_view != SENT
+    n_live = live.sum()
+    order = jnp.argsort(~live, stable=True)[:cap_x]
+    lane = jnp.arange(cap_x) < n_live
+    return (
+        jnp.where(lane, fps_view[order], SENT),
+        jnp.where(lane, fps_full[order], SENT),
+        jnp.where(lane, payload[order], -1),
+        n_live > cap_x,
+    )
+
+
+@jax.jit
+def _chunk_dedup(cv, cf, cp, visited):
+    """Stage-1 dedup over one chunk's compacted candidates.
+
+    Sorts the cap_x survivors by (fp_view, fp_full, payload), keeps the
+    min-(fp_full, payload) representative per view fingerprint (the
+    deterministic refinement of TLC's first-writer-wins), and drops
+    fingerprints already in the sorted visited store.  Small program:
+    retracing when the visited capacity grows is cheap.
+    """
+    cap_x = cv.shape[0]
+    order = jnp.lexsort((cp, cf, cv))
+    sv, sf, sp = cv[order], cf[order], cp[order]
     first = jnp.concatenate([jnp.ones((1,), bool), sv[1:] != sv[:-1]])
     pos = jnp.searchsorted(visited, sv)
     hit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == sv
     keep = first & (sv != SENT) & ~hit
     n_kept = keep.sum()
-    comp = jnp.argsort(~keep, stable=True)[:cap_x]
+    comp = jnp.argsort(~keep, stable=True)
     lane = jnp.arange(cap_x) < n_kept
     return (
-        n_kept.astype(I64),
         jnp.where(lane, sv[comp], SENT),
         jnp.where(lane, sf[comp], SENT),
         jnp.where(lane, sp[comp], -1),
-        n_kept > cap_x,
     )
 
 
@@ -199,11 +221,13 @@ class JaxChecker:
         msum = self.fpr.msg_hash(children.msgs)
         return children, msum
 
-    def _expand_chunk_impl(self, part: RaftState, msum_part, start, n_f, visited):
-        """One chunk: expand + mask + stage-1 dedup, no host syncs.
+    def _expand_chunk_impl(self, part: RaftState, msum_part, start, n_f):
+        """One chunk: expand + mask + valid-lane compaction, no host syncs.
 
         start/n_f are device i64 scalars so chunk position doesn't force
-        a recompile.  Returns compacted survivors + chunk stats.
+        a recompile; the visited store is deliberately NOT an input (its
+        capacity grows over the run and would retrace this — the largest —
+        program).  Returns compacted candidates + chunk stats.
         """
         K = self.K
         cap = part.voted_for.shape[0]
@@ -219,9 +243,7 @@ class JaxChecker:
         abort_at = jnp.where(
             ab.any(), start + jnp.argmax(ab).astype(I64), BIG
         )
-        n_kept, cv, cf, cp, overflow = _chunk_dedup(
-            fpv, fpf, payload, visited, self.cap_x
-        )
+        cv, cf, cp, overflow = _chunk_compact(fpv, fpf, payload, self.cap_x)
         return cv, cf, cp, mult_slots, abort_at, overflow
 
     def _inv_scan_impl(self, children: RaftState, n_valid):
@@ -338,13 +360,13 @@ class JaxChecker:
                 ),
                 frontier,
             )
-            cv, cf, cp, mult_slots, ab_at, ovf = self._expand_chunk(
+            cv0, cf0, cp0, mult_slots, ab_at, ovf = self._expand_chunk(
                 part,
                 msum[start : start + self.chunk],
                 jnp.asarray(start, I64),
                 n_f_dev,
-                visited,
             )
+            cv, cf, cp = _chunk_dedup(cv0, cf0, cp0, visited)
             cvs.append(cv)
             cfs.append(cf)
             cps.append(cp)
@@ -428,13 +450,14 @@ class JaxChecker:
                 self.cap_x *= 2
                 self._expand_chunk = jax.jit(self._expand_chunk_impl)
             if abort_at < n_f:
+                # action_counts stays None on violations, like the oracle:
+                # coverage of a partially-expanded level is ill-defined
                 return CheckResult(
                     False, distinct, generated, depth, tuple(level_sizes),
                     (
                         'Assert "split brain" (Raft.tla:185)',
                         self._trace(trace_levels, depth, abort_at),
                     ),
-                    self._action_counts(mult_per_slot + level_mult),
                 )
             mult_per_slot = mult_per_slot + level_mult
             generated += int(level_mult.sum())
@@ -485,13 +508,6 @@ class JaxChecker:
                         elapsed=time.monotonic() - t0,
                     )
                 )
-            if checkpoint_dir and checkpoint_every and depth % checkpoint_every == 0:
-                os.makedirs(checkpoint_dir, exist_ok=True)
-                self._save_checkpoint(
-                    os.path.join(checkpoint_dir, "latest.npz"), frontier, msum,
-                    visited, n_f, distinct, generated, depth, level_sizes,
-                    trace_levels, mult_per_slot,
-                )
             if bad_idx >= 0:
                 name = self._bad_invariant_name(children, bad_idx)
                 return CheckResult(
@@ -500,7 +516,16 @@ class JaxChecker:
                         f"Invariant {name} is violated",
                         self._trace(trace_levels, depth, bad_idx),
                     ),
-                    self._action_counts(mult_per_slot),
+                )
+            # checkpoint only invariant-clean levels: a resumed run never
+            # re-checks its loaded frontier, so saving before the check
+            # could hide a violation behind a crash+resume
+            if checkpoint_dir and checkpoint_every and depth % checkpoint_every == 0:
+                os.makedirs(checkpoint_dir, exist_ok=True)
+                self._save_checkpoint(
+                    os.path.join(checkpoint_dir, "latest.npz"), frontier, msum,
+                    visited, n_f, distinct, generated, depth, level_sizes,
+                    trace_levels, mult_per_slot,
                 )
 
         return CheckResult(

@@ -157,6 +157,40 @@ def _bit_get(msgs: jnp.ndarray, mid: jnp.ndarray) -> jnp.ndarray:
     return ((word >> (mid & 31).astype(U32)) & U32(1)).astype(jnp.bool_)
 
 
+# -- scatter-free updates --------------------------------------------------
+# XLA:TPU miscompiles scatters whose index is *data* (not a trace-constant)
+# at large batch shapes under vmap — updates are dropped or land as zeros
+# (observed twice: ClientReq's log append in round 1, and every
+# materialize-path `.at[s].set` at cap>=1024 in round 2; both caught by the
+# oracle differential).  All action updates therefore use iota-mask
+# selects: index spaces are tiny (S servers, L log slots), so a masked
+# select is also faster than a scatter on TPU.
+
+
+def _set1(vec: jnp.ndarray, i, val) -> jnp.ndarray:
+    """vec.at[i].set(val) as a select; vec 1-D, i scalar."""
+    return jnp.where(
+        jnp.arange(vec.shape[0]) == i, jnp.asarray(val).astype(vec.dtype), vec
+    )
+
+
+def _set_row(mat: jnp.ndarray, i, row) -> jnp.ndarray:
+    """mat.at[i].set(row) as a select; mat [n, m], i scalar, row [m]."""
+    return jnp.where(
+        (jnp.arange(mat.shape[0]) == i)[:, None],
+        jnp.asarray(row).astype(mat.dtype),
+        mat,
+    )
+
+
+def _set2(mat: jnp.ndarray, i, j, val) -> jnp.ndarray:
+    """mat.at[i, j].set(val) as a select; mat [n, m], i/j scalars."""
+    mask = (jnp.arange(mat.shape[0]) == i)[:, None] & (
+        jnp.arange(mat.shape[1]) == j
+    )[None, :]
+    return jnp.where(mask, jnp.asarray(val).astype(mat.dtype), mat)
+
+
 def _any(msgs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.any((msgs & mask) != 0)
 
@@ -240,9 +274,9 @@ class SuccessorKernel:
         ids = uni.encode_votereq(s + 1, peers0 + 1, new_term, ll, llt).astype(I32)
         added = jnp.full((self.A,), -1, I32).at[: ids.shape[0]].set(ids)
         child = st._replace(
-            current_term=st.current_term.at[s].set(new_term.astype(U8)),
-            role=st.role.at[s].set(U8(CANDIDATE)),
-            voted_for=st.voted_for.at[s].set((s + 1).astype(U8)),
+            current_term=_set1(st.current_term, s, new_term),
+            role=_set1(st.role, s, CANDIDATE),
+            voted_for=_set1(st.voted_for, s, s + 1),
             election_count=st.election_count + U8(1),
         )
         return valid, I32(1), child, added, False
@@ -254,9 +288,9 @@ class SuccessorKernel:
         hit = _any(st.msgs, mask)
         valid = (t > cur) & hit
         child = st._replace(
-            role=st.role.at[s].set(U8(FOLLOWER)),
-            current_term=st.current_term.at[s].set(t.astype(U8)),
-            voted_for=st.voted_for.at[s].set(U8(0)),
+            role=_set1(st.role, s, FOLLOWER),
+            current_term=_set1(st.current_term, s, t),
+            voted_for=_set1(st.voted_for, s, 0),
         )
         return valid, _popcount(st.msgs, mask), child, self._no_add(), False
 
@@ -268,7 +302,7 @@ class SuccessorKernel:
         role = st.role[s]
         valid = has & (role == CANDIDATE)
         abort = has & (role == LEADER)  # Assert "split brain", Raft.tla:185
-        child = st._replace(role=st.role.at[s].set(U8(FOLLOWER)))
+        child = st._replace(role=_set1(st.role, s, FOLLOWER))
         return valid, _popcount(st.msgs, mask), child, self._no_add(), abort
 
     def _response_vote(self, st: RaftState, c):
@@ -297,8 +331,8 @@ class SuccessorKernel:
             & _any(st.msgs, qual)
             & ~_bit_get(st.msgs, grant)
         )
-        child = st._replace(voted_for=st.voted_for.at[s].set((cand + 1).astype(U8)))
-        added = self._no_add().at[0].set(grant)
+        child = st._replace(voted_for=_set1(st.voted_for, s, cand + 1))
+        added = _set1(self._no_add(), 0, grant)
         return valid, _popcount(st.msgs, qual), child, added, False
 
     def _become_leader(self, st: RaftState, c):
@@ -311,10 +345,10 @@ class SuccessorKernel:
         ll = st.log_len[s]
         ar = jnp.arange(S)
         child = st._replace(
-            role=st.role.at[s].set(U8(LEADER)),
-            match_index=st.match_index.at[s].set(jnp.where(ar == s, ll, U8(1)).astype(U8)),
-            next_index=st.next_index.at[s].set(jnp.full((S,), 0, U8) + ll + U8(1)),
-            pending=st.pending.at[s].set(jnp.zeros((S,), U8)),
+            role=_set1(st.role, s, LEADER),
+            match_index=_set_row(st.match_index, s, jnp.where(ar == s, ll, U8(1))),
+            next_index=_set_row(st.next_index, s, jnp.full((S,), 0, U8) + ll + U8(1)),
+            pending=_set_row(st.pending, s, jnp.zeros((S,), U8)),
         )
         return valid, I32(1), child, self._no_add(), False
 
@@ -324,21 +358,18 @@ class SuccessorKernel:
         s, v = c[0], c[1]
         ll = st.log_len.astype(I32)[s]
         valid = (st.role[s] == LEADER) & (st.val_sent[v] == 0) & (ll < L)
-        # append position (0-based TLA index ll+1), written as an iota-mask
-        # select: a scatter whose index depends on state data (not the
-        # witness grid) miscompiles on XLA:TPU at large batch shapes —
-        # cross-row contamination, caught by the oracle differential.
+        # append position: 0-based slot of TLA index ll+1
         at_w = jnp.arange(L, dtype=I32) == jnp.clip(ll, 0, L - 1)
         child = st._replace(
-            val_sent=st.val_sent.at[v].set(U8(1)),  # := FALSE, Raft.tla:237
-            log_term=st.log_term.at[s].set(
-                jnp.where(at_w, st.current_term[s], st.log_term[s])
+            val_sent=_set1(st.val_sent, v, 1),  # := FALSE, Raft.tla:237
+            log_term=_set_row(
+                st.log_term, s, jnp.where(at_w, st.current_term[s], st.log_term[s])
             ),
-            log_val=st.log_val.at[s].set(
-                jnp.where(at_w, (v + 1).astype(U8), st.log_val[s])
+            log_val=_set_row(
+                st.log_val, s, jnp.where(at_w, (v + 1).astype(U8), st.log_val[s])
             ),
-            log_len=st.log_len.at[s].set((ll + 1).astype(U8)),
-            match_index=st.match_index.at[s, s].set((ll + 1).astype(U8)),
+            log_len=_set1(st.log_len, s, ll + 1),
+            match_index=_set2(st.match_index, s, s, ll + 1),
         )
         return valid, I32(1), child, self._no_add(), False
 
@@ -372,8 +403,8 @@ class SuccessorKernel:
             & (st.pending[s, d] == 0)
             & ~_bit_get(st.msgs, mid)
         )
-        child = st._replace(pending=st.pending.at[s, d].set(U8(1)))
-        return valid, I32(1), child, self._no_add().at[0].set(mid), False
+        child = st._replace(pending=_set2(st.pending, s, d, 1))
+        return valid, I32(1), child, _set1(self._no_add(), 0, mid), False
 
     def _follower_accept(self, st: RaftState, c):
         cfg, uni = self.cfg, self.uni
@@ -408,21 +439,18 @@ class SuccessorKernel:
         new_lv = jnp.where(keep, st.log_val[s], U8(0))
         new_lv = jnp.where(at_entry, eval_.astype(U8), new_lv)
         child = st._replace(
-            log_term=st.log_term.at[s].set(jnp.where(updated, new_lt, st.log_term[s])),
-            log_val=st.log_val.at[s].set(jnp.where(updated, new_lv, st.log_val[s])),
-            log_len=st.log_len.at[s].set(
-                jnp.where(updated, new_len, ll).astype(U8)
-            ),
-            commit_index=st.commit_index.at[s].set(
-                jnp.maximum(
-                    st.commit_index.astype(I32)[s], jnp.minimum(lc, new_len)
-                ).astype(U8)
+            log_term=_set_row(st.log_term, s, jnp.where(updated, new_lt, st.log_term[s])),
+            log_val=_set_row(st.log_val, s, jnp.where(updated, new_lv, st.log_val[s])),
+            log_len=_set1(st.log_len, s, jnp.where(updated, new_len, ll)),
+            commit_index=_set1(
+                st.commit_index, s,
+                jnp.maximum(st.commit_index.astype(I32)[s], jnp.minimum(lc, new_len)),
             ),
         )
         resp = uni.encode_appendresp(
             s + 1, src + 1, jnp.clip(cur, 1, T), jnp.clip(pli + el, 1, L), 1
         ).astype(I32)
-        return valid, I32(1), child, self._no_add().at[0].set(resp), False
+        return valid, I32(1), child, _set1(self._no_add(), 0, resp), False
 
     def _follower_reject(self, st: RaftState, c):
         cfg, uni = self.cfg, self.uni
@@ -442,7 +470,7 @@ class SuccessorKernel:
             (st.role[s] == FOLLOWER) & (cur >= 1) & (src != s)
             & _any(st.msgs, qual) & ~_bit_get(st.msgs, rej)
         )
-        return valid, _popcount(st.msgs, qual), st, self._no_add().at[0].set(rej), False
+        return valid, _popcount(st.msgs, qual), st, _set1(self._no_add(), 0, rej), False
 
     def _handle_append_resp(self, st: RaftState, c):
         cfg, uni = self.cfg, self.uni
@@ -461,11 +489,9 @@ class SuccessorKernel:
         ok = jnp.where(sc == 1, mi < pli, (pli + 1 == ni) & (pli > mi))
         valid = base & ok
         child = st._replace(
-            match_index=st.match_index.at[s, src].set(
-                jnp.where(sc == 1, pli, mi).astype(U8)
-            ),
-            next_index=st.next_index.at[s, src].set((pli + sc).astype(U8)),
-            pending=st.pending.at[s, src].set(U8(0)),
+            match_index=_set2(st.match_index, s, src, jnp.where(sc == 1, pli, mi)),
+            next_index=_set2(st.next_index, s, src, pli + sc),
+            pending=_set2(st.pending, s, src, 0),
         )
         return valid, I32(1), child, self._no_add(), False
 
@@ -475,7 +501,7 @@ class SuccessorKernel:
         row = jnp.sort(st.match_index.astype(I32)[s])
         med = row[cfg.median_index]  # Median(F), Raft.tla:70-75 (or mutation)
         valid = (st.role[s] == LEADER) & (med > st.commit_index.astype(I32)[s])
-        child = st._replace(commit_index=st.commit_index.at[s].set(med.astype(U8)))
+        child = st._replace(commit_index=_set1(st.commit_index, s, med))
         return valid, I32(1), child, self._no_add(), False
 
     def _restart(self, st: RaftState, c):
@@ -485,7 +511,7 @@ class SuccessorKernel:
             st.restart_count.astype(I32) < cfg.max_restart
         )
         child = st._replace(
-            role=st.role.at[s].set(U8(FOLLOWER)),
+            role=_set1(st.role, s, FOLLOWER),
             restart_count=st.restart_count + U8(1),
         )
         return valid, I32(1), child, self._no_add(), False
@@ -541,7 +567,8 @@ class SuccessorKernel:
                     live = mid >= 0
                     w = jnp.clip(mid, 0, None) >> 5
                     bit = jnp.where(live, U32(1) << (mid & 31).astype(U32), U32(0))
-                    return m.at[w].set(m[w] | bit)
+                    word_hit = jnp.arange(m.shape[0], dtype=I32) == w
+                    return jnp.where(word_hit, m | bit, m)
 
                 for a in range(self.A):
                     msgs = set_bit(msgs, added[a])

@@ -501,24 +501,26 @@ class ShardedChecker:
                 visited = grow_visited(visited, self.vcap * 4)
             # the level step is pure, so failed (overflowed) outputs drop
             # and the retry recomputes the level at the grown capacity
-            for _retry in range(8):
+            grows = 0
+            while True:
                 out = self.level_step(frontier, msum, n_f, visited)
+                if not (bool(out.overflow_v) or bool(out.overflow_x)):
+                    break
+                if grows >= 8:
+                    raise RuntimeError(
+                        f"capacity overflow at level {depth + 1} "
+                        f"(cap_x={self.cap_x}, cap_r={self.cap_r}, "
+                        f"vcap={self.vcap})"
+                    )
+                grows += 1
                 if bool(out.overflow_v):
                     visited = grow_visited(visited, self.vcap * 4)
-                elif bool(out.overflow_x):
+                else:
                     # candidate compaction / routing lanes overflowed: grow
                     # cap_x (recompiles the level step — rare)
                     self.cap_x *= 2
                     self.__dict__.pop("level_step", None)
                     self.__dict__.pop("cap_r", None)
-                else:
-                    break
-            else:
-                raise RuntimeError(
-                    f"capacity overflow at level {depth + 1} "
-                    f"(cap_x={self.cap_x}, cap_r={self.cap_r}, "
-                    f"vcap={self.vcap})"
-                )
             if bool(out.abort):
                 # locate the aborting parent (a current-frontier state) and
                 # replay its slot chain, exactly like the single-device path
@@ -526,13 +528,13 @@ class ShardedChecker:
                 devs = np.nonzero(bad_at >= 0)[0]
                 cap_f = frontier.voted_for.shape[0] // D
                 gidx = int(devs[0]) * cap_f + int(bad_at[devs[0]])
+                # action_counts stays None on violations, like the oracle
                 return CheckResult(
                     False, distinct, generated, depth, tuple(level_sizes),
                     (
                         'Assert "split brain" (Raft.tla:185)',
                         self._trace(trace_levels, depth, gidx),
                     ),
-                    self._action_counts(mult_slots_total),
                 )
             mult_slots_total += np.asarray(out.mult_slots)
             generated += int(np.asarray(out.generated))
@@ -555,13 +557,6 @@ class ShardedChecker:
                 visited = jax.device_put(out.visited[:keep], repl)
             frontier, msum = out.children, out.child_msum
             n_f = jax.device_put(out.n_new_local, shard)
-            if checkpoint_dir and checkpoint_every and depth % checkpoint_every == 0:
-                os.makedirs(checkpoint_dir, exist_ok=True)
-                self._save_checkpoint(
-                    os.path.join(checkpoint_dir, "latest.npz"), frontier, msum,
-                    n_f, visited, distinct, generated, depth, level_sizes,
-                    trace_levels, mult_slots_total,
-                )
             if self.progress is not None:
                 self.progress(
                     dict(
@@ -591,7 +586,15 @@ class ShardedChecker:
                 return CheckResult(
                     False, distinct, generated, depth, tuple(level_sizes),
                     (f"Invariant {name} is violated", trace),
-                    self._action_counts(mult_slots_total),
+                )
+            # checkpoint only invariant-clean levels (a resumed run never
+            # re-checks the loaded frontier)
+            if checkpoint_dir and checkpoint_every and depth % checkpoint_every == 0:
+                os.makedirs(checkpoint_dir, exist_ok=True)
+                self._save_checkpoint(
+                    os.path.join(checkpoint_dir, "latest.npz"), frontier, msum,
+                    n_f, visited, distinct, generated, depth, level_sizes,
+                    trace_levels, mult_slots_total,
                 )
 
         return CheckResult(
