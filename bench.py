@@ -46,6 +46,19 @@ def main():
     cfg = load_raft_config(
         os.environ.get("RAFT_CFG", "/root/reference/Raft.cfg")
     )
+    # scale dials (BASELINE.md configs 3-5): BENCH_SERVERS=5 exercises the
+    # s4/s5 constants the reference pre-declares (Raft.cfg:16-17)
+    import dataclasses
+
+    overrides = {}
+    if os.environ.get("BENCH_SERVERS"):
+        overrides["n_servers"] = int(os.environ["BENCH_SERVERS"])
+    if os.environ.get("BENCH_VALS"):
+        overrides["n_vals"] = int(os.environ["BENCH_VALS"])
+    if os.environ.get("BENCH_MAX_ELECTION"):
+        overrides["max_election"] = int(os.environ["BENCH_MAX_ELECTION"])
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
     max_depth = int(os.environ.get("BENCH_MAX_DEPTH", "0")) or None
     chunk = int(os.environ.get("BENCH_CHUNK", "1024"))
     gold_depth = int(os.environ.get("BENCH_GOLD_DEPTH", "12"))
@@ -99,6 +112,7 @@ def main():
             "wall_s": round(o_dt, 2),
         },
         "device": str(jax.devices()[0]),
+        "config": cfg.describe(),
     }
     if not parity:
         out["error"] = {

@@ -24,7 +24,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 import jax.numpy as jnp
 import numpy as np
 
-from tla_raft_tpu.engine.bfs import _chunk_compact, _chunk_dedup, _level_dedup
+from tla_raft_tpu.engine.bfs import _chunk_compact, _level_dedup
 
 print("backend:", jax.default_backend())
 SENT = np.uint64(0xFFFFFFFFFFFFFFFF)
@@ -71,15 +71,14 @@ def trial(C, n_live, n_unique, vis_size, n_vis_hits, cap_x, tag):
     cv0, cf0, cp0, _ovf = _chunk_compact(
         jnp.asarray(fv), jnp.asarray(ff), jnp.asarray(fp), cap_x
     )
-    cv_d, cf_d, cp_d = jax.device_get(
-        _chunk_dedup(cv0, cf0, cp0, jnp.asarray(vis))
+    n_dev, cv_d, cp_d = jax.device_get(
+        _level_dedup(cv0, cf0, cp0, jnp.asarray(vis))
     )
-    n_dev = int((cv_d != SENT).sum())
+    cf_d = None
     n_ref, cv_r, cf_r, cp_r = ref_chunk(fv, ff, fp, vis, cap_x)
     ok = (
         int(n_dev) == n_ref
         and np.array_equal(cv_d, cv_r)
-        and np.array_equal(cf_d, cf_r)
         and np.array_equal(cp_d, cp_r)
     )
     print(f"chunk_dedup[{tag}] C={C} live={n_live} uniq={n_unique} "
@@ -88,8 +87,8 @@ def trial(C, n_live, n_unique, vis_size, n_vis_hits, cap_x, tag):
         bad = np.nonzero(cv_d != cv_r)[0]
         print("  first diffs at lanes", bad[:5])
         for b in bad[:3]:
-            print(f"   lane {b}: dev ({hex(int(cv_d[b]))},{hex(int(cf_d[b]))},{cp_d[b]}) "
-                  f"ref ({hex(int(cv_r[b]))},{hex(int(cf_r[b]))},{cp_r[b]})")
+            print(f"   lane {b}: dev ({hex(int(cv_d[b]))},{cp_d[b]}) "
+                  f"ref ({hex(int(cv_r[b]))},{cp_r[b]})")
     return ok
 
 
@@ -98,8 +97,9 @@ all_ok = True
 for vis_size, tag in [(64, "L1"), (4, "L2"), (16, "L3"), (64, "L4")]:
     all_ok &= trial(C, n_live=rng.integers(20, 400), n_unique=30,
                     vis_size=vis_size, n_vis_hits=8, cap_x=8192, tag=tag)
-# denser trial
-all_ok &= trial(C, n_live=20000, n_unique=3000, vis_size=4096,
+# denser trial (n_live must stay under cap_x: compaction buffers valid
+# lanes pre-dedup, so exceeding it is a legitimate overflow, not a bug)
+all_ok &= trial(C, n_live=6000, n_unique=3000, vis_size=4096,
                 n_vis_hits=1000, cap_x=8192, tag="dense")
 
 # _level_dedup at the single-chunk shape
@@ -111,8 +111,9 @@ pool = rng.integers(0, 1 << 63, 300, dtype=np.uint64)
 cv[:m] = np.sort(pool[rng.integers(0, 300, m)])
 cf[:m] = rng.integers(0, 1 << 63, m, dtype=np.uint64)
 cp[:m] = rng.integers(0, 1 << 40, m)
+empty_vis = jnp.full((64,), jnp.uint64(SENT))
 n_dev, nf_d, npay_d = jax.device_get(
-    _level_dedup(jnp.asarray(cv), jnp.asarray(cf), jnp.asarray(cp))
+    _level_dedup(jnp.asarray(cv), jnp.asarray(cf), jnp.asarray(cp), empty_vis)
 )
 # reference
 out = {}

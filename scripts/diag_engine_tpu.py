@@ -135,8 +135,8 @@ for li in range(n_levels):
         for d in mult_diff[:5]:
             print(f"  mult slot {d}: dev {int(np.asarray(mult)[d])} want {int(want_mult[d])}")
         # localize per chunk: run each chunk's fused program and also its
-        # pieces (expand jit alone, then compact+dedup on numpy-side masks)
-        from tla_raft_tpu.engine.bfs import I64, SENT, _chunk_compact, _chunk_dedup
+        # pieces (expand jit alone, then compaction on numpy-side masks)
+        from tla_raft_tpu.engine.bfs import I64, SENT, _chunk_compact
 
         cap_f = frontier.voted_for.shape[0]
         for start in range(0, min(cap_f, max(n_f, 1)), chunk):
@@ -146,11 +146,10 @@ for li in range(n_levels):
                 ),
                 frontier,
             )
-            cv0, cf0, cp0, mult_slots, ab, ovf = chk._expand_chunk(
+            cv, cf_, cp, mult_slots, ab, ovf = chk._expand_chunk(
                 part, msum[start : start + chunk], jnp.asarray(start, I64),
                 jnp.asarray(n_f, I64),
             )
-            cv, cf_, cp = _chunk_dedup(cv0, cf0, cp0, visited)
             # piecewise: standalone expand (proven clean) + standalone compact
             exp = chk.kern.expand(part, msum[start : start + chunk])
             K = chk.K
@@ -163,7 +162,6 @@ for li in range(n_levels):
             cv2, cf2, cp2, ovf2 = _chunk_compact(
                 jnp.asarray(fpv), jnp.asarray(fpf), jnp.asarray(payload), chk.cap_x
             )
-            cv2, cf2, cp2 = _chunk_dedup(cv2, cf2, cp2, visited)
             same = np.array_equal(np.asarray(cv), np.asarray(cv2)) and np.array_equal(
                 np.asarray(cp), np.asarray(cp2)
             )

@@ -1,0 +1,74 @@
+"""Decompose per-chunk cost: dispatch latency vs compute, batch scaling.
+
+Times (a) a trivial jitted op (pure dispatch+transfer floor), (b) the
+expand kernel alone at several batch sizes, (c) expand+compact fused, on
+the current backend.  Slope vs intercept tells whether to grow chunks or
+shrink the kernel.
+
+Usage: PYTHONPATH=. python scripts/probe_dispatch.py [--cpu]
+"""
+
+import sys
+import time
+
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import os
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/tla_raft_tpu_jax")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.models.raft import init_batch
+
+cfg = load_raft_config("/root/reference/Raft.cfg")
+print("backend:", jax.default_backend())
+
+
+def timeit(label, fn, n=10):
+    fn()
+    jax.block_until_ready(fn())
+    t0 = time.monotonic()
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / n
+    print(f"  {label:<40} {dt * 1e3:9.2f} ms")
+    return dt
+
+
+x = jnp.zeros((8, 128))
+f_triv = jax.jit(lambda x: x + 1)
+timeit("trivial jit (dispatch floor)", lambda: f_triv(x))
+
+y = jnp.zeros((1024, 696), jnp.uint64)
+f_dev = jax.jit(lambda y: y.sum())
+timeit("sum of 712k u64 (readback floor)", lambda: f_dev(y))
+
+for B in (256, 1024, 2048):
+    chk = JaxChecker(cfg, chunk=B)
+    batch = init_batch(cfg, B)
+    _, _, msum = chk.fpr.state_fingerprints(batch)
+    jax.block_until_ready(msum)
+    ex = chk.kern.expand
+    t = timeit(f"expand only          B={B}", lambda: ex(batch, msum), n=5)
+    print(f"    -> {t / B * 1e6:.1f} us/state")
+    from tla_raft_tpu.engine.bfs import I64
+
+    t = timeit(
+        f"expand+compact fused B={B}",
+        lambda: chk._expand_chunk(batch, msum, jnp.asarray(0, I64), jnp.asarray(B, I64)),
+        n=5,
+    )
+    print(f"    -> {t / B * 1e6:.1f} us/state")

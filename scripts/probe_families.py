@@ -1,0 +1,68 @@
+"""Time each action family's expand separately at chunk shapes.
+
+Identifies which family's guard/effect code carries the table traffic
+that dominates the fused expand kernel (see docs/PERF.md).
+
+Usage: PYTHONPATH=. python scripts/probe_families.py [B] [--cpu]
+"""
+
+import sys
+import time
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() else 2048
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import os
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/tla_raft_tpu_jax")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.models.raft import init_batch
+
+cfg = load_raft_config("/root/reference/Raft.cfg")
+chk = JaxChecker(cfg, chunk=B)
+kern = chk.kern
+batch = init_batch(cfg, B)
+_, _, msum = kern.fpr.state_fingerprints(batch)
+jax.block_until_ready(msum)
+print("backend:", jax.default_backend(), "B =", B)
+
+
+def timeit(label, fn, n=5):
+    jax.block_until_ready(fn())
+    t0 = time.monotonic()
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / n
+    print(f"  {label:<36} {dt * 1e3:9.2f} ms")
+    return dt
+
+
+total = 0.0
+for fi, (name, fn, coords) in enumerate(kern.families):
+    cj = jnp.asarray(coords)
+
+    def fam_expand(st, ms, fn=fn, cj=cj):
+        def per_state(st1, ms1):
+            return kern._family_expand(fn, cj, st1, ms1)
+
+        return jax.vmap(per_state)(st, ms)
+
+    f = jax.jit(fam_expand)
+    t = timeit(f"family {fi:2d} {name} (W={coords.shape[0]})", lambda: f(batch, msum))
+    total += t
+print(f"  sum of families: {total * 1e3:.1f} ms")
+t = timeit("fused expand (all families)", lambda: kern.expand(batch, msum))

@@ -1,0 +1,119 @@
+"""Micro-benchmark the expand kernel's gather patterns on the backend.
+
+cost_analysis of the fused expand shows ~500KB of table traffic per
+fan-out lane — some batched gather lowers to full-table scans.  Time each
+suspect standalone at chunk shapes (B=2048, K=696, A=2):
+
+  1. delta-hash rows:   G_rows[ids]            [M+1, P, C] u32, 2.8M ids
+  2. guard-mask rows:   vq_uptodate[...]       [S,S,T,T+1,L,W] u32, 1.4M idx
+  3. popcount over masked words (the _any/_popcount pattern)
+  4. feature hash matmul [1.4M, F] @ [F, P*C*4]
+  5. log-term scalar gather lt[s, ll-1] style
+
+Usage: PYTHONPATH=. python scripts/probe_gather.py [--cpu]
+"""
+
+import sys
+import time
+
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import os
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/tla_raft_tpu_jax")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.ops.fingerprint import get_fingerprinter
+from tla_raft_tpu.ops.successor import GuardTables
+
+cfg = load_raft_config("/root/reference/Raft.cfg")
+fpr = get_fingerprinter(cfg)
+tables = GuardTables(cfg)
+print("backend:", jax.default_backend())
+
+B, K, A = 2048, 696, 2
+N = B * K
+rng = np.random.default_rng(0)
+
+
+def timeit(label, fn, n=5):
+    jax.block_until_ready(fn())
+    t0 = time.monotonic()
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / n
+    print(f"  {label:<46} {dt * 1e3:9.2f} ms")
+    return dt
+
+
+M = fpr.uni.M
+ids = jnp.asarray(rng.integers(0, M + 1, (N, A)), jnp.int32)
+live = jnp.asarray(rng.random((N, A)) < 0.5)
+
+# 1. delta-hash gather as used in the kernel
+f1 = jax.jit(lambda ids, live: fpr.delta_hash(ids, live).sum())
+timeit("delta_hash rows G_rows[ids]  (2.8M ids)", lambda: f1(ids, live))
+
+
+# 2. guard-table row gather (vq_uptodate) at 1.4M witness tuples
+S, T, L = cfg.S, cfg.T, cfg.L
+ci = jnp.asarray(rng.integers(0, S, N), jnp.int32)
+si = jnp.asarray(rng.integers(0, S, N), jnp.int32)
+ti = jnp.asarray(rng.integers(0, T, N), jnp.int32)
+lti = jnp.asarray(rng.integers(0, T + 1, N), jnp.int32)
+lli = jnp.asarray(rng.integers(0, L, N), jnp.int32)
+msgs = jnp.asarray(rng.integers(0, 2**32, (B, tables.uni.n_words), np.uint32))
+
+
+def guard_rows(ci, si, ti, lti, lli):
+    rows = tables.vq_uptodate[ci, si, ti, lti, lli]  # [N, W]
+    return rows.sum()
+
+
+f2 = jax.jit(guard_rows)
+timeit("guard row gather vq_uptodate (1.4M rows)", lambda: f2(ci, si, ti, lti, lli))
+
+# 3. popcount of masked words: per (state, slot) over the state's msgs
+msgs_rep = msgs[:, None, :]  # [B, 1, W]
+
+
+def pop(ci, si, ti, lti, lli):
+    rows = tables.vq_uptodate[ci, si, ti, lti, lli].reshape(B, K, -1)
+    return jax.lax.population_count(msgs_rep & rows).sum()
+
+
+f3 = jax.jit(pop)
+timeit("guard rows + popcount vs msgs", lambda: f3(ci, si, ti, lti, lli))
+
+# 4. feature-hash matmul at full lane count
+feats = jnp.asarray(rng.integers(0, 4, (N, fpr.spec.F)), jnp.int8)
+f4 = jax.jit(lambda f: fpr.feat_hash(f).sum())
+timeit("feat_hash matmul [1.4M, F]", lambda: f4(feats))
+
+# 5. per-lane scalar gather from a small per-state array
+lt = jnp.asarray(rng.integers(0, T + 1, (B, S, L)), jnp.uint8)
+pos = jnp.asarray(rng.integers(0, L, (B, K)), jnp.int32)
+srv = jnp.asarray(rng.integers(0, S, (B, K)), jnp.int32)
+
+
+def scalar_gather(lt, pos, srv):
+    def per_state(lt1, pos1, srv1):
+        return jax.vmap(lambda p, s: lt1[s, p])(pos1, srv1)
+
+    return jax.vmap(per_state)(lt, pos, srv).sum()
+
+
+f5 = jax.jit(scalar_gather)
+timeit("per-lane scalar gather lt[s, pos]", lambda: f5(lt, pos, srv))

@@ -1,0 +1,134 @@
+"""Separate per-launch overhead from real op cost on the tunneled TPU.
+
+Runs each candidate op once vs R times inside a single jitted fori_loop:
+  real_op_cost ~= (t_R - t_1) / (R - 1);  launch_overhead ~= t_1 - real.
+Also a pure-bandwidth op (x * 2 on 100MB) as a sanity check.
+
+Usage: PYTHONPATH=. python scripts/probe_launch.py [--cpu]
+"""
+
+import sys
+import time
+
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import os
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/tla_raft_tpu_jax")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.ops.fingerprint import get_fingerprinter
+
+cfg = load_raft_config("/root/reference/Raft.cfg")
+fpr = get_fingerprinter(cfg)
+print("backend:", jax.default_backend())
+
+rng = np.random.default_rng(0)
+N = 2048 * 696
+
+
+def timeit(label, fn, n=5):
+    jax.block_until_ready(fn())
+    t0 = time.monotonic()
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / n
+    print(f"  {label:<46} {dt * 1e3:9.2f} ms")
+    return dt
+
+
+def repeat_in_jit(op, x, R):
+    def body(_i, acc):
+        return acc + op(x + acc.astype(x.dtype)[..., :1] * 0)
+
+    # accumulate so the loop body cannot be hoisted/folded
+    def run(x):
+        def body(i, acc):
+            return acc + op(x + (acc % 2).astype(x.dtype))
+
+        return jax.lax.fori_loop(0, R, body, jnp.zeros((), jnp.int64))
+
+    return jax.jit(run)
+
+
+# 1. bandwidth sanity: elementwise on 100MB
+big = jnp.asarray(rng.integers(0, 255, (100 * 1024 * 1024,), np.uint8))
+f_bw = jax.jit(lambda x: (x * 2).sum(dtype=jnp.int64))
+timeit("elementwise+reduce on 100MB", lambda: f_bw(big))
+
+# 2. scalar-per-lane gather, 1 vs 10 reps in one program
+lt = jnp.asarray(rng.integers(0, 4, (2048, 3, 3)), jnp.uint8)
+pos = jnp.asarray(rng.integers(0, 3, (2048, 696)), jnp.int32)
+srv = jnp.asarray(rng.integers(0, 3, (2048, 696)), jnp.int32)
+
+
+def gather_op(lt):
+    def per_state(lt1, pos1, srv1):
+        return jax.vmap(lambda p, s: lt1[s, p])(pos1, srv1)
+
+    return jax.vmap(per_state)(lt, pos, srv).sum(dtype=jnp.int64)
+
+
+def gather_R(R):
+    def run(lt):
+        def body(i, acc):
+            return acc + gather_op(lt + (acc % 2).astype(jnp.uint8))
+
+        return jax.lax.fori_loop(0, R, body, jnp.zeros((), jnp.int64))
+
+    return jax.jit(run)
+
+
+t1 = timeit("scalar gather x1 (in-loop)", lambda: gather_R(1)(lt))
+t10 = timeit("scalar gather x10 (in-loop)", lambda: gather_R(10)(lt))
+print(f"    -> per-op {1e3 * (t10 - t1) / 9:.2f} ms, launch {1e3 * (t1 - (t10 - t1) / 9):.2f} ms")
+
+# 3. feature-hash matmul, 1 vs 10 reps
+feats = jnp.asarray(rng.integers(0, 4, (N, fpr.spec.F)), jnp.int8)
+
+
+def mm_R(R):
+    def run(f):
+        def body(i, acc):
+            return acc + fpr.feat_hash(f + (acc % 2).astype(jnp.int8)).sum(dtype=jnp.uint32).astype(jnp.int64)
+
+        return jax.lax.fori_loop(0, R, body, jnp.zeros((), jnp.int64))
+
+    return jax.jit(run)
+
+
+t1 = timeit("feat_hash x1 (in-loop)", lambda: mm_R(1)(feats))
+t10 = timeit("feat_hash x10 (in-loop)", lambda: mm_R(10)(feats))
+print(f"    -> per-op {1e3 * (t10 - t1) / 9:.2f} ms, launch {1e3 * (t1 - (t10 - t1) / 9):.2f} ms")
+
+# 4. delta_hash gather, 1 vs 10 reps
+M = fpr.uni.M
+ids = jnp.asarray(rng.integers(0, M + 1, (N, 2)), jnp.int32)
+live = jnp.asarray(rng.random((N, 2)) < 0.5)
+
+
+def dh_R(R):
+    def run(ids):
+        def body(i, acc):
+            return acc + fpr.delta_hash(ids + (acc % 2).astype(jnp.int32), live).sum(dtype=jnp.uint32).astype(jnp.int64)
+
+        return jax.lax.fori_loop(0, R, body, jnp.zeros((), jnp.int64))
+
+    return jax.jit(run)
+
+
+t1 = timeit("delta_hash x1 (in-loop)", lambda: dh_R(1)(ids))
+t10 = timeit("delta_hash x10 (in-loop)", lambda: dh_R(10)(ids))
+print(f"    -> per-op {1e3 * (t10 - t1) / 9:.2f} ms, launch {1e3 * (t1 - (t10 - t1) / 9):.2f} ms")

@@ -39,23 +39,7 @@ cfg = load_raft_config("/root/reference/Raft.cfg")
 print("backend:", jax.default_backend(), "chunk:", chunk, "to depth", depth)
 
 chk = JaxChecker(cfg, chunk=chunk)
-
-# drive the engine to `depth` by hand (mirrors run()'s loop, keeps arrays)
-frontier = None
-
-
-class Capture(Exception):
-    pass
-
-
-orig = chk._expand_level
 state = {}
-
-
-def capture_expand(frontier, msum, n_f, visited):
-    state.update(frontier=frontier, msum=msum, n_f=n_f, visited=visited)
-    return orig(frontier, msum, n_f, visited)
-
 
 t0 = time.monotonic()
 res = chk.run(max_depth=depth)
@@ -65,7 +49,6 @@ print(
 )
 
 chk2 = JaxChecker(cfg, chunk=chunk)
-chk2._expand_level = capture_expand.__get__(chk2)
 
 
 # re-run capturing the last level's inputs
@@ -100,19 +83,15 @@ starts = list(range(0, min(cap_f, max(n_f, 1)), chunk))
 print(f"level with {len(starts)} chunks of {chunk} (K={chk2.K}):")
 
 
-from tla_raft_tpu.engine.bfs import _chunk_dedup
-
-
 def one_chunk(start):
     part = jax.tree.map(
         lambda x: jax.lax.dynamic_slice_in_dim(x, start, min(chunk, cap_f - start), 0),
         frontier,
     )
-    cv0, cf0, cp0, mult_slots, ab, ovf = chk2._expand_chunk(
+    return chk2._expand_chunk(
         part, msum[start : start + chunk], jnp.asarray(start, I64),
         jnp.asarray(n_f, I64),
     )
-    return _chunk_dedup(cv0, cf0, cp0, visited) + (mult_slots, ab, ovf)
 
 
 timeit("one chunk (expand+dedup1)", lambda: one_chunk(0))
@@ -129,13 +108,12 @@ cfs = jnp.concatenate([o[1] for o in outs])
 cps = jnp.concatenate([o[2] for o in outs])
 jax.block_until_ready((cvs, cfs, cps))
 print(f"  level-dedup input lanes: {cvs.shape[0]}")
-timeit("level dedup (sort survivors)", lambda: _level_dedup(cvs, cfs, cps))
-n_new_dev, new_fps, new_payload = _level_dedup(cvs, cfs, cps)
+timeit("level dedup (sort+visited filter)", lambda: _level_dedup(cvs, cfs, cps, visited))
+n_new_dev, new_fps, new_payload = _level_dedup(cvs, cfs, cps, visited)
 timeit("host fetch n_new", lambda: int(n_new_dev))
 n_new = int(n_new_dev)
 print(f"  n_new = {n_new}")
 pay_np = np.asarray(new_payload[:n_new])
-cap_c = max(1 << ((max(n_new - 1, 0)).bit_length() + 1) // 2 * 2, chunk)
 from tla_raft_tpu.engine.bfs import _cap4, _pad_axis0
 
 cap_c = max(_cap4(n_new), chunk)

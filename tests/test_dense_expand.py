@@ -1,0 +1,88 @@
+"""Dense (tensorized) expand vs the scalar reference kernel, bit-exact.
+
+ops/dense_expand.py re-derives pass 1 as block algebra; any divergence
+from the scalar vmap formulation (ops/successor.py) on (valid, mult,
+fp_view, fp_full, abort) is a bug in one of them.  The scalar kernel is
+itself differentially tested against the oracle (test_successor.py), so
+equality here chains dense -> scalar -> oracle.
+"""
+
+import numpy as np
+import pytest
+
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.models.raft import from_oracle
+from tla_raft_tpu.ops.successor import SuccessorKernel
+from tla_raft_tpu.oracle.explicit import init_state, successors
+
+CFGS = [
+    RaftConfig(n_servers=2, n_vals=1, max_election=2, max_restart=1),
+    RaftConfig(n_servers=3, n_vals=2, max_election=2, max_restart=1),
+    RaftConfig(n_servers=3, n_vals=1, max_election=2, max_restart=0,
+               mutations=("double-vote",)),
+]
+
+
+def collect(cfg, n):
+    from tla_raft_tpu.oracle.explicit import SplitBrainAbort
+
+    seen, order, frontier = {init_state(cfg)}, [init_state(cfg)], [init_state(cfg)]
+    while frontier and len(order) < n:
+        nxt = []
+        for st in frontier:
+            try:
+                succs = successors(cfg, st)
+            except SplitBrainAbort:
+                continue
+            for _a, _s, _d, ch in succs:
+                if ch not in seen:
+                    seen.add(ch)
+                    order.append(ch)
+                    nxt.append(ch)
+        frontier = nxt
+    return order[:n]
+
+
+@pytest.mark.parametrize("cfg", CFGS, ids=["s2", "s3", "s3-doublevote"])
+def test_dense_matches_scalar(cfg):
+    kern = SuccessorKernel(cfg)
+    states = collect(cfg, 160)
+    batch = from_oracle(cfg, states)
+    _, _, msum = kern.fpr.state_fingerprints(batch)
+    dense = kern.expand(batch, msum)
+    ref = kern.expand_reference(batch, msum)
+    valid_d, valid_r = np.asarray(dense.valid), np.asarray(ref.valid)
+    assert np.array_equal(valid_d, valid_r), (
+        np.argwhere(valid_d != valid_r)[:10]
+    )
+    assert np.array_equal(np.asarray(dense.mult), np.asarray(ref.mult)), (
+        np.argwhere(np.asarray(dense.mult) != np.asarray(ref.mult))[:10]
+    )
+    fpv_d, fpv_r = np.asarray(dense.fp_view), np.asarray(ref.fp_view)
+    bad = valid_r & (fpv_d != fpv_r)
+    assert not bad.any(), np.argwhere(bad)[:10]
+    fpf_d, fpf_r = np.asarray(dense.fp_full), np.asarray(ref.fp_full)
+    bad = valid_r & (fpf_d != fpf_r)
+    assert not bad.any(), np.argwhere(bad)[:10]
+    assert np.array_equal(np.asarray(dense.abort), np.asarray(ref.abort))
+
+
+def test_dense_matches_scalar_s5():
+    import dataclasses
+
+    from tla_raft_tpu.cfgparse import load_raft_config
+
+    cfg = dataclasses.replace(
+        load_raft_config("/root/reference/Raft.cfg"), n_servers=5
+    )
+    kern = SuccessorKernel(cfg)
+    states = collect(cfg, 32)
+    batch = from_oracle(cfg, states)
+    _, _, msum = kern.fpr.state_fingerprints(batch)
+    dense = kern.expand(batch, msum)
+    ref = kern.expand_reference(batch, msum)
+    valid_r = np.asarray(ref.valid)
+    assert np.array_equal(np.asarray(dense.valid), valid_r)
+    assert np.array_equal(np.asarray(dense.mult), np.asarray(ref.mult))
+    assert not (valid_r & (np.asarray(dense.fp_view) != np.asarray(ref.fp_view))).any()
+    assert not (valid_r & (np.asarray(dense.fp_full) != np.asarray(ref.fp_full))).any()

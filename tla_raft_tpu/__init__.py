@@ -13,7 +13,8 @@ as data-parallel JAX/XLA kernels:
   fingerprint store + per-core frontier shards deduplicated with ICI
   collectives each BFS level (parallel/),
 - symmetry reduction (Raft.cfg:24) and the VIEW projection (Raft.cfg:26)
-  are permutation gather tables + a slot-level 64-bit hash (ops/hashing.py),
+  are permutation-folded coefficient tables + a multilinear 64-bit hash
+  run as int8 MXU matmuls (ops/fingerprint.py),
 - a pure-Python explicit-state checker (oracle/) reproduces TLC's semantics
   exactly and serves as the differential-testing oracle, since the reference
   publishes no numbers and TLC itself (a Java tool) is not vendored.

@@ -7,18 +7,20 @@ reconstruction and checkpointing of the external TLC jar driven by
 
 * a **frontier** of full states held as padded struct-of-array tensors,
 * the successor kernel's masked fan-out (ops/successor.py) run in chunks,
-* **two-stage dedup**, all on device:
-    1. per chunk: sort the chunk's (fp_view, fp_full, payload) candidate
-       triples, keep the min-(fp_full, payload) representative per view
+* **compact-then-dedup**, all on device:
+    1. per chunk: a ``top_k`` partial sort compacts the ~0.5%-dense valid
+       lanes of the |chunk|*K fan-out into a fixed cap_x lane budget
+       (no dedup, no visited access — the expand program stays
+       shape-stable for the whole run);
+    2. per level: one lexsort over all chunks' compacted candidates
+       picks the min-(fp_full, payload) representative per view
        fingerprint (the deterministic refinement of TLC's
-       first-writer-wins — see oracle/explicit.py), drop fingerprints
-       already in the sorted visited store (``searchsorted``), and
-       compact survivors into a fixed per-chunk lane budget;
-    2. per level: one small sort over the compacted chunk survivors
-       resolves cross-chunk duplicates.
-  Stage 1 shrinks the level-wide sort from |frontier|*K dense lanes to
-  a few thousand survivors per chunk — the difference between sorting
-  ~10^8 and ~10^6 keys per level at full scale.
+       first-writer-wins — see oracle/explicit.py) and drops states
+       already in the sorted visited store (``searchsorted``).
+  Compaction shrinks the level-wide sort from |frontier|*K dense lanes
+  to the ~3.5 valid candidates per frontier state (measured on the
+  reference config) padded to the cap_x budget — the difference between
+  sorting ~10^8 and ~10^6 keys per level at full scale.
 * **materialization** of only the surviving (parent, slot) pairs,
 * batched invariant kernels (engine/invariants.py) on each new level,
 * per-level (parent, slot) spill to the host for counterexample traces
@@ -105,67 +107,55 @@ def _chunk_compact(fps_view, fps_full, payload, cap_x: int):
     """Compact one chunk's valid fan-out lanes into cap_x lanes (no dedup).
 
     fps_view/full u64[C] (SENT where invalid), payload i64[C] (global
-    parent*K+slot).  A stable bool-key argsort moves the ~0.5%-dense valid
-    lanes to the front — far cheaper than sorting C u64 triples, and it
-    keeps the visited store out of this (large, shape-stable) program so
-    store growth never recompiles the expand kernel.
+    parent*K+slot).  ``top_k`` on an earliest-lane-first key is a partial
+    sort — far cheaper than a full argsort over the ~0.5%-dense C lanes,
+    and it keeps the visited store out of this (large, shape-stable)
+    program so store growth never recompiles the expand kernel.  Kept
+    lanes preserve original lane order (payload-ascending), matching the
+    stable compaction the dedup's determinism contract assumes.
     """
+    C = fps_view.shape[0]
     live = fps_view != SENT
     n_live = live.sum()
-    order = jnp.argsort(~live, stable=True)[:cap_x]
-    lane = jnp.arange(cap_x) < n_live
+    key = jnp.where(live, C - jnp.arange(C, dtype=I32), 0)
+    vals, idx = jax.lax.top_k(key, cap_x)  # descending = earliest lanes first
+    lane = vals > 0
     return (
-        jnp.where(lane, fps_view[order], SENT),
-        jnp.where(lane, fps_full[order], SENT),
-        jnp.where(lane, payload[order], -1),
+        jnp.where(lane, fps_view[idx], SENT),
+        jnp.where(lane, fps_full[idx], SENT),
+        jnp.where(lane, payload[idx], -1),
         n_live > cap_x,
     )
 
 
 @jax.jit
-def _chunk_dedup(cv, cf, cp, visited):
-    """Stage-1 dedup over one chunk's compacted candidates.
+def _level_dedup(cv, cf, cp, visited):
+    """Global dedup over the level's compacted candidates, on device.
 
-    Sorts the cap_x survivors by (fp_view, fp_full, payload), keeps the
-    min-(fp_full, payload) representative per view fingerprint (the
-    deterministic refinement of TLC's first-writer-wins), and drops
-    fingerprints already in the sorted visited store.  Small program:
-    retracing when the visited capacity grows is cheap.
-    """
-    cap_x = cv.shape[0]
-    order = jnp.lexsort((cp, cf, cv))
-    sv, sf, sp = cv[order], cf[order], cp[order]
-    first = jnp.concatenate([jnp.ones((1,), bool), sv[1:] != sv[:-1]])
-    pos = jnp.searchsorted(visited, sv)
-    hit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == sv
-    keep = first & (sv != SENT) & ~hit
-    n_kept = keep.sum()
-    comp = jnp.argsort(~keep, stable=True)
-    lane = jnp.arange(cap_x) < n_kept
-    return (
-        jnp.where(lane, sv[comp], SENT),
-        jnp.where(lane, sf[comp], SENT),
-        jnp.where(lane, sp[comp], -1),
-    )
-
-
-@jax.jit
-def _level_dedup(cv, cf, cp):
-    """Stage-2 dedup across chunk survivors (already visited-filtered).
+    One lexsort by (fp_view, fp_full, payload) across every chunk's
+    candidates resolves uniqueness and picks the min-(fp_full, payload)
+    representative per view fingerprint (the deterministic refinement of
+    TLC's first-writer-wins); a searchsorted against the sorted visited
+    store drops already-known states.  Doing this once per level instead
+    of per chunk halves the sort work of the old two-stage scheme.
+    Retraces when the visited capacity grows — acceptable, the program is
+    small next to the expand kernel.
 
     Returns (n_new, new_fps u64[C] view-sorted SENT-padded, payload i64[C]).
     """
     order = jnp.lexsort((cp, cf, cv))
-    sv = cv[order]
+    sv, sp = cv[order], cp[order]
     first = jnp.concatenate([jnp.ones((1,), bool), sv[1:] != sv[:-1]])
-    new = first & (sv != SENT)
+    pos = jnp.searchsorted(visited, sv)
+    hit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == sv
+    new = first & (sv != SENT) & ~hit
     n_new = new.sum()
     comp = jnp.argsort(~new, stable=True)
     keep = jnp.arange(sv.shape[0]) < n_new
     return (
         n_new,
         jnp.where(keep, sv[comp], SENT),
-        jnp.where(keep, cp[order][comp], -1),
+        jnp.where(keep, sp[comp], -1),
     )
 
 
@@ -197,10 +187,15 @@ class JaxChecker:
         self.kern: SuccessorKernel = get_kernel(cfg)
         self.fpr = self.kern.fpr
         self.K = self.kern.K
+        if chunk & (chunk - 1):
+            # power-of-two capacities divide evenly into the pow4-padded
+            # materialize buffer; arbitrary chunks would mis-slice it
+            raise ValueError(f"chunk must be a power of two, got {chunk}")
         self.chunk = chunk
-        # frontiers roughly double per level, so a chunk's ~chunk*2 new
-        # states (plus slack for multiplicity spikes) fit 8*chunk lanes
-        self.cap_x = cap_x or 8 * chunk
+        # a chunk's valid fan-out lanes average ~3.5 per parent on the
+        # reference config, so chunk*4 covers the mean and overflow
+        # detection grows the budget (with a re-jit) on skewed chunks
+        self.cap_x = cap_x or 4 * chunk
         self.progress = progress
         # optional native external-memory visited store (native/fpstore.cpp);
         # when set, the device keeps no visited table at all — the level's
@@ -360,13 +355,12 @@ class JaxChecker:
                 ),
                 frontier,
             )
-            cv0, cf0, cp0, mult_slots, ab_at, ovf = self._expand_chunk(
+            cv, cf, cp, mult_slots, ab_at, ovf = self._expand_chunk(
                 part,
                 msum[start : start + self.chunk],
                 jnp.asarray(start, I64),
                 n_f_dev,
             )
-            cv, cf, cp = _chunk_dedup(cv0, cf0, cp0, visited)
             cvs.append(cv)
             cfs.append(cf)
             cps.append(cp)
@@ -374,7 +368,8 @@ class JaxChecker:
             abort_at = jnp.minimum(abort_at, ab_at)
             overflow = overflow | ovf
         n_new_dev, new_fps, new_payload = _level_dedup(
-            jnp.concatenate(cvs), jnp.concatenate(cfs), jnp.concatenate(cps)
+            jnp.concatenate(cvs), jnp.concatenate(cfs), jnp.concatenate(cps),
+            visited,
         )
         # ONE host sync for the level's control state
         n_new, ab, ovf, mult_np = jax.device_get(
@@ -393,6 +388,13 @@ class JaxChecker:
         K = self.K
         t0 = time.monotonic()
 
+        if self.host_store is not None and (resume_from or checkpoint_dir):
+            raise ValueError(
+                "host_store cannot be combined with checkpoint/resume: the "
+                ".npz snapshot does not capture the on-disk store, so a "
+                "resumed run would see its own pre-crash inserts as "
+                "already-visited and report a truncated clean sweep"
+            )
         if resume_from is not None:
             ck = self._load_checkpoint(resume_from)
             frontier, msum, visited = ck["frontier"], ck["msum"], ck["visited"]
@@ -438,7 +440,7 @@ class JaxChecker:
         while n_f > 0:
             if max_depth is not None and depth >= max_depth:
                 break
-            # --- expand + two-stage dedup (device), fused level fetch ----
+            # --- expand + compact-then-dedup (device), fused level fetch -
             while True:
                 (n_new, new_fps, new_payload, abort_at, overflow, level_mult
                  ) = self._expand_level(frontier, msum, n_f, visited)
@@ -474,13 +476,29 @@ class JaxChecker:
 
             # --- materialize the survivors ------------------------------
             # never shrink below one chunk: keeps the expand kernel at one
-            # compiled shape instead of one per pow2 frontier size
+            # compiled shape instead of one per pow2 frontier size.
+            # Materialization runs in chunk-sized slices: msg_hash unpacks
+            # a [n, n_words, 32] intermediate that would OOM at millions
+            # of survivors in one call.
             cap_c = max(_cap4(n_new), self.chunk)
             pidx_np = pay_np // K
             slot_np = pay_np % K
             pidx = _pad_axis0(jnp.asarray(pidx_np, I64), cap_c)
             slots = _pad_axis0(jnp.asarray(slot_np, I64), cap_c)
-            children, child_msum = self._gather_mat(frontier, pidx, slots)
+            if cap_c <= 4 * self.chunk:
+                children, child_msum = self._gather_mat(frontier, pidx, slots)
+            else:
+                sl = 4 * self.chunk  # divides cap_c (both powers of two)
+                parts = [
+                    self._gather_mat(
+                        frontier, pidx[off : off + sl], slots[off : off + sl]
+                    )
+                    for off in range(0, cap_c, sl)
+                ]
+                children = jax.tree.map(
+                    lambda *xs: jnp.concatenate(xs), *(p[0] for p in parts)
+                )
+                child_msum = jnp.concatenate([p[1] for p in parts])
 
             # --- bookkeeping, invariants, store merge -------------------
             trace_levels.append((pidx_np.astype(np.int64), slot_np.astype(np.int64)))

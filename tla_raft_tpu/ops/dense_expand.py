@@ -1,0 +1,548 @@
+"""Tensorized successor fan-out: ``Next`` as dense block algebra, no gathers.
+
+The scalar-per-witness formulation in ops/successor.py (action functions
+vmap'd over a coordinate grid) is semantically exact but maps poorly onto
+the TPU backend: every ``x[s]`` / table-row read inside the vmap is a
+data-indexed gather, and a launched program containing gathers pays a
+fixed multi-millisecond penalty on this platform (measured — see
+docs/PERF.md), putting expand at ~40 us/state.
+
+This module re-derives pass 1 (validity, multiplicity, child
+fingerprints, split-brain abort) in fully dense form:
+
+* **witness digits are array axes** — per-server state reads are
+  axis-aligned broadcasts, never gathers;
+* **the message set is viewed as mixed-radix blocks** — static reshapes
+  of the unpacked bit vector (``[B, pair, term, ...]`` per message type),
+  so guard existence/counting is reductions plus tiny one-hot
+  contractions over the data-dependent digits (term, prevLogTerm, ...);
+* **fingerprints are incremental** — ``h(child) = h(parent) +
+  sum C_eff[changed] * delta`` over the byte-plane-linearized
+  multilinear hash (ops/fingerprint.py); added-message coefficients are
+  computed arithmetically (``mix32`` + the pair-digit permutation trick,
+  ops/msg_universe.py) — no per-candidate feature extraction, no
+  coefficient table.
+
+Slot layout (family order, witness-grid raveling) is IDENTICAL to
+SuccessorKernel.families, so payloads, traces, coverage accounting and
+the materialize pass are unchanged.  tests/test_dense_expand.py asserts
+bit-exact equality of (valid, mult, fp_view, fp_full, abort) against the
+scalar kernel on reachable states.
+
+Spec citations live with the scalar transcriptions in ops/successor.py
+(Raft.tla:107-414); this file implements the same guarded effects.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..config import CANDIDATE, FOLLOWER, LEADER, RaftConfig
+from .fingerprint import Fingerprinter, _effective_u32
+from .msg_universe import MsgUniverse, _dst_from_idx
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+def _oh(x, n):
+    """One-hot over a tiny digit range; i32 for exact integer contraction."""
+    return (x[..., None] == jnp.arange(n, dtype=x.dtype)).astype(I32)
+
+
+class DenseExpand:
+    """Dense pass-1 expand for one RaftConfig.
+
+    Constructed by SuccessorKernel; shares the fingerprinter (coefficient
+    tables, seed) and the message universe (layout constants)."""
+
+    def __init__(self, cfg: RaftConfig, uni: MsgUniverse, fpr: Fingerprinter):
+        self.cfg = cfg
+        self.uni = uni
+        self.fpr = fpr
+        S, T, L, V = cfg.S, cfg.T, cfg.L, cfg.V
+        E = uni.n_entry
+        NP = S * (S - 1)
+        self.S, self.T, self.L, self.V, self.E, self.NP = S, T, L, V, E, NP
+        P, NC = fpr.P, fpr.N_CHAN
+
+        # ---- pair-digit constants ---------------------------------------
+        pair_of = np.zeros((S, S), np.int64)  # (a0, b0) -> pair digit a->b
+        for src in range(1, S + 1):
+            for di in range(S - 1):
+                dst = _dst_from_idx(src, di)
+                pair_of[src - 1, dst - 1] = (src - 1) * (S - 1) + di
+        self._pair_of = pair_of
+        # SELP[a, b, p]: one-hot of pair(a->b); zero row on the diagonal
+        selp = np.zeros((S, S, NP), np.int64)
+        for a in range(S):
+            for b in range(S):
+                if a != b:
+                    selp[a, b, pair_of[a, b]] = 1
+        self.SELP = jnp.asarray(selp, I32)
+        # SELD[b, p]: pairs delivering TO b (sum over sources)
+        self.SELD = jnp.asarray(selp.sum(0), I32)
+        self.PPERM = uni.pair_perm_table.astype(np.int64)  # [P, NP] host
+
+        # ResponseVote up-to-date qualifier (Raft.tla:145-147):
+        # QUAL[llt, lli0, myllt, mylli0] = llt > myllt \/ (= /\ lli >= mylli)
+        q = np.zeros((T, L, T + 1, L), np.int64)
+        for k in range(T):
+            for l0 in range(L):
+                for m in range(T + 1):
+                    for j0 in range(L):
+                        q[k, l0, m, j0] = int((k > m) or (k == m and l0 >= j0))
+        self.QUAL = jnp.asarray(q, I32)
+
+        # ---- effective feature-coefficient blocks -----------------------
+        ceff = _effective_u32(fpr._Cp_np).transpose(2, 0, 1)  # [F, P, chan]
+        sp = fpr.spec
+
+        def cf(slice_, *shape):
+            return jnp.asarray(
+                ceff[slice_].reshape(*shape, P, NC), jnp.uint32
+            )
+
+        self.C_ct = cf(sp.ct, S)
+        self.C_role = cf(sp.role, S)
+        self.C_lt = cf(sp.lt, S, L)
+        self.C_lv = cf(sp.lv, S, L)
+        self.C_ll = cf(sp.ll, S)
+        self.C_mi = cf(sp.mi, S, S)
+        self.C_ni = cf(sp.ni, S, S)
+        self.C_ci = cf(sp.ci, S)
+        self.C_vf = cf(sp.vf_oh, S, S + 1)
+        self.C_ec = cf(sp.ec, 1)[0]  # [P, chan]
+        self.C_rc = cf(sp.rc, 1)[0]
+        self.C_pend = cf(sp.pend, S, S)
+        self.C_vs = cf(sp.vs, V)
+        cvf = np.asarray(self.C_vf)
+        self.C_vf_self = jnp.asarray(
+            np.stack([cvf[s, s + 1] for s in range(S)]), jnp.uint32
+        )  # votedFor[s] := s+1
+        cmi = np.asarray(self.C_mi)
+        self.C_mi_diag = jnp.asarray(
+            np.stack([cmi[s, s] for s in range(S)]), jnp.uint32
+        )
+
+        # FollowerAcceptEntry witness constants over (pli0=l, e, lc0=h)
+        EL = np.array([0] + [1] * (E - 1), np.int64)  # entry carried?
+        ETERM = np.array(
+            [0] + [(e - 1) // V + 1 for e in range(1, E)], np.int64
+        )
+        EVAL = np.array([0] + [(e - 1) % V + 1 for e in range(1, E)], np.int64)
+        NL = (np.arange(L)[:, None] + 1) + EL[None, :]  # new_len [l, e]
+        PI = np.minimum(NL, L)  # resp prevLogIndex [l, e]
+        self.EL = jnp.asarray(EL, I32)
+        self.ETERM = jnp.asarray(ETERM, I32)
+        self.EVAL = jnp.asarray(EVAL, I32)
+        self.NL = jnp.asarray(NL, I32)
+        self.MINLC = jnp.asarray(
+            np.minimum(np.arange(1, L + 1)[None, None, :], NL[:, :, None]),
+            I32,
+        )  # min(lc, new_len) [l, e, h]
+        # keep/at-entry masks for the log rewrite [j, l] / [j, l, e]
+        jj, ll_ = np.meshgrid(np.arange(L), np.arange(L), indexing="ij")
+        KEEP = (jj <= ll_).astype(np.int64)  # j < pli  (j0 <= l)
+        POS = np.minimum(np.arange(L) + 1, L - 1)  # entry slot per l
+        AT = np.zeros((L, L, E), np.int64)
+        for l0 in range(L):
+            AT[POS[l0], l0, 1:] = 1
+        self.KEEPX = jnp.asarray(KEEP[:, :, None] * (1 - AT) , I32)  # [j, l, e]
+        self.AT = jnp.asarray(AT, I32)
+        self.PI = jnp.asarray(PI, I32)
+
+        # BecomeCandidate peers (s -> the S-1 others, broadcast order)
+        if S > 1:
+            peers = np.stack(
+                [[(s + 1 + r) % S for r in range(S - 1)] for s in range(S)]
+            )
+            self._pair_peers = pair_of[np.arange(S)[:, None], peers]  # [S, S-1]
+            selpeer = np.zeros((S, S - 1, NP), np.int64)
+            for s in range(S):
+                for r in range(S - 1):
+                    selpeer[s, r, self._pair_peers[s, r]] = 1
+            self.SELPEER = jnp.asarray(selpeer, I32)
+        self._pair_ab = pair_of  # [a, b] np (diagonal entries unused)
+
+    # ---- added-message hash contribution --------------------------------
+
+    def _add_msg(self, pair_const: np.ndarray, type_idx: int, rest, live):
+        """One added message per lane: pair_const np[*axes], rest i32[B,*axes],
+        live i32[B,*axes] (1 = actually added).  u32[B, *axes, P, chan]."""
+        off = self.uni.type_offsets[type_idx]
+        stride = self.uni.type_strides[type_idx]
+        pp = np.moveaxis(self.PPERM[:, pair_const], 0, -1)  # [*axes, P]
+        id_p = jnp.asarray(off + pp * stride, I32) + rest[..., None]
+        g = self.fpr.msg_coef_eff(id_p)
+        return jnp.where(live[..., None, None] != 0, g, U32(0))
+
+    # ---- the expand ------------------------------------------------------
+
+    def __call__(self, st, msum):
+        cfg, uni = self.cfg, self.uni
+        S, T, L, V, E, NP = self.S, self.T, self.L, self.V, self.E, self.NP
+        P, NC = self.fpr.P, self.fpr.N_CHAN
+        B = st.voted_for.shape[0]
+        i32 = lambda x: x.astype(I32)
+
+        ct = i32(st.current_term)  # [B, S]
+        vf = i32(st.voted_for)
+        role = i32(st.role)
+        ll = i32(st.log_len)
+        lt = i32(st.log_term)  # [B, S, L]
+        lv = i32(st.log_val)
+        mi = i32(st.match_index)  # [B, S, S]
+        ni = i32(st.next_index)
+        ci = i32(st.commit_index)
+        pend = i32(st.pending)
+        ec = i32(st.election_count)  # [B]
+        rc = i32(st.restart_count)
+        vs = i32(st.val_sent)  # [B, V]
+
+        # ---- message-block views (static reshapes) ----------------------
+        bits = self.fpr.unpack_bits(st.msgs).astype(I32)  # [B, M]
+        vq = bits[:, : uni.vp_off].reshape(B, NP, T, L, T)
+        vp = bits[:, uni.vp_off : uni.aq_off].reshape(B, NP, T)
+        aq = bits[:, uni.aq_off : uni.ap_off].reshape(B, NP, T, L, T + 1, E, L)
+        ap = bits[:, uni.ap_off :].reshape(B, NP, T, L, 2)
+
+        # ---- per-chunk aggregates ---------------------------------------
+        vq_r = vq.sum((3, 4), dtype=I32)  # [B, NP, T]
+        aq_r = aq.sum((3, 4, 5, 6), dtype=I32)
+        ap_r = ap.sum((3, 4), dtype=I32)
+        to_cnt = jnp.einsum("bpt,dp->bdt", vq_r + vp + aq_r + ap_r, self.SELD)
+        aq_to_cnt = jnp.einsum("bpt,dp->bdt", aq_r, self.SELD)
+        AQR = aq.sum((5, 6), dtype=I32)  # [B, NP, T, L, T+1]
+        ap0, ap1 = ap[..., 0], ap[..., 1]  # [B, NP, T, L]
+
+        # shared one-hots / scalars
+        oh_ct = _oh(jnp.clip(ct - 1, 0, T - 1), T)  # cur-term digit
+        has_term = ct >= 1
+        oh_ll_pos = _oh(jnp.clip(ll - 1, 0, L - 1), L)  # mylli digit (ll-1)
+        llt_val = (oh_ll_pos * lt).sum(-1, dtype=I32)  # lt[b, s, ll-1]
+        oh_vfw = _oh(vf, S + 1).astype(U32)
+        old_vf_c = jnp.einsum("bsw,swpc->bspc", oh_vfw, self.C_vf)
+        not_self = ~jnp.eye(S, dtype=bool)[None]
+        tcur1 = jnp.clip(ct, 1, T)  # term clamped to >= 1 for encoders
+
+        base = self.fpr.feat_hash(self.fpr.spec.features(st)) + msum  # [B,P,C]
+
+        fpv_parts, fpf_parts, valid_parts, mult_parts = [], [], [], []
+
+        def emit(valid, mult, dh):
+            """valid bool[B,*W], mult i32[B,*W], dh u32[B,*W,P,chan]."""
+            h = base.reshape(B, *([1] * (dh.ndim - 3)), P, NC) + dh
+            v, f = self.fpr.finalize(h)
+            valid_parts.append(valid.reshape(B, -1))
+            mult_parts.append(mult.reshape(B, -1))
+            fpv_parts.append(v.reshape(B, -1))
+            fpf_parts.append(f.reshape(B, -1))
+
+        def dmul(C, delta):
+            """C u32[*idx, P, chan] * delta i32[..., *idx] (broadcasted)."""
+            return C * delta.astype(U32)[..., None, None]
+
+        # ---- F0 BecomeCandidate(s)  axes [B, s] --------------------------
+        new_term = jnp.clip(ct + 1, 1, T)
+        llt_cand = jnp.clip(llt_val, 0, T - 1)  # lastLogTerm < minted term
+        valid0 = (ec[:, None] < cfg.max_election) & (
+            (role == FOLLOWER) | (role == CANDIDATE)
+        )
+        dh0 = (
+            dmul(self.C_ct, new_term - ct)
+            + dmul(self.C_role, CANDIDATE - role)
+            + self.C_vf_self
+            - old_vf_c
+            + self.C_ec
+        )
+        if S > 1:
+            oh_t0 = _oh(new_term - 1, T)
+            oh_lli0 = oh_ll_pos
+            oh_llt0 = _oh(llt_cand, T)
+            present0 = jnp.einsum(
+                "bptlk,srp,bst,bsl,bsk->bsr",
+                vq, self.SELPEER, oh_t0, oh_lli0, oh_llt0,
+            )  # [B, s, peer]
+            rest0 = ((new_term - 1) * L + (ll - 1)) * T + llt_cand  # [B, s]
+            dmsg0 = self._add_msg(
+                self._pair_peers, 0,
+                jnp.broadcast_to(rest0[:, :, None], (B, S, S - 1)),
+                1 - present0,
+            ).sum(2, dtype=U32)
+            dh0 = dh0 + dmsg0
+        emit(valid0, jnp.ones((B, S), I32), dh0)
+
+        # ---- F1 UpdateTerm branch (a)  axes [B, s, t0] -------------------
+        t_ax = jnp.arange(1, T + 1, dtype=I32)
+        valid1 = (t_ax[None, None, :] > ct[:, :, None]) & (to_cnt > 0)
+        dh1 = (
+            dmul(self.C_ct[:, None], t_ax[None, None, :] - ct[:, :, None])
+            + (dmul(self.C_role, FOLLOWER - role) + self.C_vf[:, 0] - old_vf_c)[
+                :, :, None
+            ]
+        )
+        emit(valid1, to_cnt, dh1)
+
+        # ---- F2 UpdateTerm branch (b) + Assert  axes [B, s] --------------
+        cnt2 = jnp.einsum("bdt,bdt->bd", aq_to_cnt, oh_ct)
+        has2 = has_term & (cnt2 > 0)
+        valid2 = has2 & (role == CANDIDATE)
+        abort = (has2 & (role == LEADER)).any(1)
+        dh2 = dmul(self.C_role, FOLLOWER - role)
+        emit(valid2, cnt2, dh2)
+
+        # ---- F3 ResponseVote(s, cand)  axes [B, s, c] --------------------
+        UP = jnp.einsum("bptlk,klmj->bptmj", vq, self.QUAL)
+        oh_myllt = _oh(jnp.clip(llt_val, 0, T), T + 1)
+        qual_cnt = jnp.einsum(
+            "bptmj,csp,bst,bsm,bsj->bsc",
+            UP, self.SELP, oh_ct, oh_myllt, oh_ll_pos,
+        )
+        grant_bit = jnp.einsum("bpt,scp,bst->bsc", vp, self.SELP, oh_ct)
+        if "double-vote" in cfg.mutations:
+            vf_ok = jnp.ones((B, S, S), bool)
+        else:
+            vf_ok = (vf[:, :, None] == 0) | (
+                vf[:, :, None] == jnp.arange(1, S + 1, dtype=I32)[None, None, :]
+            )
+        valid3 = (
+            (role == FOLLOWER)[:, :, None]
+            & has_term[:, :, None]
+            & not_self
+            & vf_ok
+            & (qual_cnt > 0)
+            & (grant_bit == 0)
+        )
+        # votedFor[s]: old -> cand+1
+        dh3 = self.C_vf[None, :, 1:] - old_vf_c[:, :, None]
+        rest3 = jnp.broadcast_to((tcur1 - 1)[:, :, None], (B, S, S))
+        dmsg3 = self._add_msg(self._pair_ab, 1, rest3, 1 - grant_bit)
+        emit(valid3, qual_cnt, dh3 + dmsg3)
+
+        # ---- F4 BecomeLeader(s)  axes [B, s] -----------------------------
+        votes = jnp.einsum("bpt,sp,bst->bs", vp, self.SELD, oh_ct)
+        valid4 = (role == CANDIDATE) & (votes + 1 >= cfg.majority)
+        ar = jnp.arange(S, dtype=I32)
+        mi_tgt = jnp.where(ar[None, None, :] == ar[None, :, None], ll[:, :, None], 1)
+        dh4 = (
+            dmul(self.C_role, LEADER - role)
+            + jnp.einsum(
+                "bsu,supc->bspc", (mi_tgt - mi).astype(U32), self.C_mi
+            )
+            + jnp.einsum(
+                "bsu,supc->bspc", ((ll[:, :, None] + 1) - ni).astype(U32), self.C_ni
+            )
+            + jnp.einsum("bsu,supc->bspc", (-pend).astype(U32), self.C_pend)
+        )
+        emit(valid4, jnp.ones((B, S), I32), dh4)
+
+        # ---- F5 ClientReq(s, v)  axes [B, s, v] --------------------------
+        valid5 = (
+            (role == LEADER)[:, :, None]
+            & (vs[:, None, :] == 0)
+            & (ll < L)[:, :, None]
+        )
+        pos_oh = _oh(jnp.clip(ll, 0, L - 1), L)  # append slot (0-based = ll)
+        d_lt5 = jnp.einsum(
+            "bsl,slpc->bspc",
+            (pos_oh * (ct[:, :, None] - lt)).astype(U32), self.C_lt,
+        )
+        C_lv_pos = jnp.einsum("bsl,slpc->bspc", pos_oh.astype(U32), self.C_lv)
+        lv_pos = (pos_oh * lv).sum(-1, dtype=I32)  # [B, s]
+        v_val = jnp.arange(1, V + 1, dtype=I32)
+        d_lv5 = C_lv_pos[:, :, None] * (
+            (v_val[None, None, :] - lv_pos[:, :, None]).astype(U32)[..., None, None]
+        )
+        d_mid5 = dmul(self.C_mi_diag, (ll + 1) - jnp.einsum("bss->bs", mi))
+        d_vs5 = dmul(self.C_vs, 1 - vs)  # [B, v, P, C]
+        dh5 = (d_lt5 + self.C_ll + d_mid5)[:, :, None] + d_lv5 + d_vs5[:, None]
+        emit(valid5, jnp.ones((B, S, V), I32), dh5)
+
+        # ---- F6 LeaderAppendEntry(s, d)  axes [B, s, d] ------------------
+        pli6 = jnp.clip(ni - 1, 1, L)
+        prev_oh = _oh(jnp.clip(ni - 2, 0, L - 1), L)
+        plt6 = jnp.clip(jnp.einsum("bsdl,bsl->bsd", prev_oh, lt), 0, T)
+        has_e = ni <= ll[:, :, None]
+        epos_oh = _oh(jnp.clip(ni - 1, 0, L - 1), L)
+        et6 = jnp.clip(jnp.einsum("bsdl,bsl->bsd", epos_oh, lt), 1, T)
+        ev6 = jnp.clip(jnp.einsum("bsdl,bsl->bsd", epos_oh, lv), 1, V)
+        ecode6 = jnp.where(has_e, 1 + (et6 - 1) * V + (ev6 - 1), 0)
+        lc6 = jnp.clip(ci, 1, L)[:, :, None]
+        present6 = jnp.einsum(
+            "bqtlmeh,sdq,bsdt,bsdl,bsdm,bsde,bsdh->bsd",
+            aq, self.SELP,
+            _oh(jnp.broadcast_to(tcur1[:, :, None], (B, S, S)) - 1, T),
+            _oh(pli6 - 1, L), _oh(plt6, T + 1), _oh(ecode6, E),
+            _oh(jnp.broadcast_to(lc6, (B, S, S)) - 1, L),
+        )
+        valid6 = (
+            (role == LEADER)[:, :, None]
+            & not_self
+            & (ni <= ll[:, :, None] + 1)
+            & (pend == 0)
+            & (present6 == 0)
+        )
+        dh6 = jnp.einsum("bsd,sdpc->bsdpc", (1 - pend).astype(U32), self.C_pend)
+        rest6 = (
+            (((tcur1[:, :, None] - 1) * L + (pli6 - 1)) * (T + 1) + plt6) * E
+            + ecode6
+        ) * L + (lc6 - 1)
+        dmsg6 = self._add_msg(self._pair_ab, 2, rest6, 1 - present6)
+        emit(valid6, jnp.ones((B, S, S), I32), dh6 + dmsg6)
+
+        # ---- F7 FollowerAcceptEntry(s, src, pli, e, lc)  -----------------
+        # axes [B, s, c(src), l(pli0), e, h(lc0)]
+        plt7 = jnp.clip(lt, 0, T)  # lt[b, s, pli-1] axis-aligned over l
+        oh_plt7 = _oh(plt7, T + 1)  # [B, s, l, T+1]
+        present7 = jnp.einsum(
+            "bqtlmeh,csq,bst,bslm->bscleh", aq, self.SELP, oh_ct, oh_plt7
+        )
+        pli_ax = jnp.arange(1, L + 1, dtype=I32)
+        log_match = pli_ax[None, None, :] <= ll[:, :, None]  # [B, s, l]
+        valid7 = (
+            (role == FOLLOWER)[:, :, None, None, None, None]
+            & has_term[:, :, None, None, None, None]
+            & not_self[:, :, :, None, None, None]
+            & log_match[:, :, None, :, None, None]
+            & (present7 > 0)
+        )
+        # log rewrite deltas (only when `updated`)
+        append_new = self.NL[None, None] > ll[:, :, None, None]  # [B, s, l, e]
+        lt_next = jnp.concatenate([lt[..., 1:], lt[..., -1:]], axis=-1)
+        lv_next = jnp.concatenate([lv[..., 1:], lv[..., -1:]], axis=-1)
+        conflict = (
+            (self.EL[None, None, None] == 1)
+            & (pli_ax[None, None, :, None] < ll[:, :, None, None])
+            & (
+                (lt_next[:, :, :, None] != self.ETERM[None, None, None])
+                | (lv_next[:, :, :, None] != self.EVAL[None, None, None])
+            )
+        )
+        updated = (append_new | conflict).astype(I32)  # [B, s, l, e]
+        # delta_lt[b,s,j,l,e] = (KEEPX-1)*lt[j] + AT*ETERM[e]
+        d_lt_j = (self.KEEPX[None, None] - 1) * lt[:, :, :, None, None] + (
+            self.AT[None, None] * self.ETERM[None, None, None, None]
+        )
+        d_lv_j = (self.KEEPX[None, None] - 1) * lv[:, :, :, None, None] + (
+            self.AT[None, None] * self.EVAL[None, None, None, None]
+        )
+        d_log7 = jnp.einsum(
+            "bsjle,sjpc->bslepc", d_lt_j.astype(U32), self.C_lt
+        ) + jnp.einsum("bsjle,sjpc->bslepc", d_lv_j.astype(U32), self.C_lv)
+        d_ll7 = dmul(
+            self.C_ll[:, None, None], self.NL[None, None] - ll[:, :, None, None]
+        )
+        d_upd7 = (d_log7 + d_ll7) * updated.astype(U32)[..., None, None]
+        # commitIndex := max(ci, min(lc, new_len)) — unconditional
+        d_ci7 = dmul(
+            self.C_ci[:, None, None, None],
+            jnp.maximum(ci[:, :, None, None, None], self.MINLC[None, None])
+            - ci[:, :, None, None, None],
+        )  # [B, s, l, e, h, P, C]
+        # success AppendResp s -> src at cur with prevLogIndex PI[l, e]
+        oh_pi = _oh(self.PI - 1, L)  # [l, e, L]
+        resp_present7 = jnp.einsum(
+            "bqtj,scq,bst,lej->bscle", ap1, self.SELP, oh_ct, oh_pi
+        )
+        rest7 = ((tcur1 - 1)[:, :, None, None] * L + (self.PI[None, None] - 1)) * 2 + 1
+        dmsg7 = self._add_msg(
+            self._pair_ab[:, :, None, None],  # [s, c, 1, 1] pair(s->c)
+            3,
+            jnp.broadcast_to(rest7[:, :, None], (B, S, S, L, E)),
+            1 - resp_present7,
+        )  # [B, s, c, l, e, P, C]
+        dh7 = (
+            d_upd7[:, :, None, :, :, None]
+            + d_ci7[:, :, None]
+            + dmsg7[:, :, :, :, :, None]
+        )
+        emit(
+            valid7,
+            jnp.ones((B, S, S, L, E, L), I32),
+            jnp.broadcast_to(dh7, (B, S, S, L, E, L, P, NC)),
+        )
+
+        # ---- F8 FollowerRejectEntry(s, src, pli)  axes [B, s, c, l] ------
+        tot8 = jnp.einsum(
+            "bqtlm,csq,bst->bscl", AQR, self.SELP, oh_ct
+        )
+        match8 = jnp.einsum(
+            "bqtlm,csq,bst,bslm->bscl", AQR, self.SELP, oh_ct, oh_plt7
+        )
+        cnt8 = tot8 - jnp.where(
+            log_match[:, :, None, :], match8, 0
+        )
+        rej_bit = jnp.einsum("bqtl,scq,bst->bscl", ap0, self.SELP, oh_ct)
+        valid8 = (
+            (role == FOLLOWER)[:, :, None, None]
+            & has_term[:, :, None, None]
+            & not_self[:, :, :, None]
+            & (cnt8 > 0)
+            & (rej_bit == 0)
+        )
+        rest8 = jnp.broadcast_to(
+            ((tcur1 - 1)[:, :, None, None] * L + jnp.arange(L, dtype=I32)) * 2,
+            (B, S, S, L),
+        )
+        dmsg8 = self._add_msg(
+            self._pair_ab[:, :, None], 3, rest8, 1 - rej_bit
+        )
+        emit(valid8, cnt8, dmsg8)
+
+        # ---- F9 HandleAppendResp(s, src, pli, succ)  [B, s, c, l, x] -----
+        bit9 = jnp.einsum("bqtlx,csq,bst->bsclx", ap, self.SELP, oh_ct)
+        pli9 = pli_ax[None, None, None, :]  # [1,1,1,l]
+        mi_sc = mi[:, :, :, None]
+        ni_sc = ni[:, :, :, None]
+        ok_succ = mi_sc < pli9
+        ok_fail = (pli9 + 1 == ni_sc) & (pli9 > mi_sc)
+        ok9 = jnp.stack([ok_fail, ok_succ], axis=-1)
+        valid9 = (
+            (role == LEADER)[:, :, None, None, None]
+            & has_term[:, :, None, None, None]
+            & not_self[:, :, :, None, None]
+            & (pend == 1)[:, :, :, None, None]
+            & (bit9 > 0)
+            & ok9
+        )
+        x_ax = jnp.arange(2, dtype=I32)
+        d_mi9 = dmul(
+            self.C_mi[:, :, None, None],
+            x_ax * (pli9[..., None] - mi_sc[..., None]),
+        )
+        d_ni9 = dmul(
+            self.C_ni[:, :, None, None],
+            pli9[..., None] + x_ax - ni_sc[..., None],
+        )
+        d_p9 = dmul(self.C_pend[:, :, None, None], -pend[:, :, :, None, None])
+        emit(valid9, jnp.ones((B, S, S, L, 2), I32), d_mi9 + d_ni9 + d_p9)
+
+        # ---- F10 LeaderCanCommit(s)  axes [B, s] -------------------------
+        # median_index-th order statistic without a sort op: the stable
+        # ascending-sort position of row element u is #(x_w < x_u) +
+        # #(w < u with x_w == x_u); select the element whose position is
+        # the median index (S is tiny, so the S^2 compare grid is cheap)
+        xu = mi[:, :, :, None]  # [B, s, u, w]
+        xw = mi[:, :, None, :]
+        tri = (jnp.arange(S)[:, None] > jnp.arange(S)[None, :]).astype(I32)
+        pos = (xw < xu).sum(-1, dtype=I32) + ((xw == xu) * tri[None, None]).sum(
+            -1, dtype=I32
+        )
+        med = (mi * (pos == cfg.median_index)).sum(-1, dtype=I32)
+        valid10 = (role == LEADER) & (med > ci)
+        dh10 = dmul(self.C_ci, med - ci)
+        emit(valid10, jnp.ones((B, S), I32), dh10)
+
+        # ---- F11 Restart(s)  axes [B, s] ---------------------------------
+        valid11 = (role == LEADER) & (rc[:, None] < cfg.max_restart)
+        dh11 = dmul(self.C_role, FOLLOWER - role) + self.C_rc
+        emit(valid11, jnp.ones((B, S), I32), dh11)
+
+        valid = jnp.concatenate(valid_parts, axis=1)
+        mult = jnp.concatenate(mult_parts, axis=1)
+        fpv = jnp.concatenate(fpv_parts, axis=1)
+        fpf = jnp.concatenate(fpf_parts, axis=1)
+        return valid, mult, fpv, fpf, abort

@@ -189,6 +189,50 @@ def _u32_to_i8_planes(c: np.ndarray) -> np.ndarray:
     return b.astype(np.uint8).astype(np.int8)
 
 
+# -- computed message coefficients -----------------------------------------
+# The message-set hash coefficient for (channel c, message id m) is a
+# *computed* u32, not a stored table: G[c, m] = mix32(m*PHI + c*PHI2 + seed).
+# The full-state path still materializes G as a host-built matrix for the
+# bits @ G matmul, but the successor path evaluates the coefficient
+# arithmetically per added message — a handful of VPU ops instead of a
+# row gather from a [M+1, P, chan] table, which XLA:TPU lowers to
+# full-table scans per lane (measured ~500KB of reads per fan-out lane,
+# ~750GB per chunk; see docs/PERF.md).
+
+_PHI = 0x9E3779B9
+_PHI2 = 0x85EBCA6B
+
+
+def _mix32(x):
+    """splitmix32-style finalizer; identical semantics for np and jnp."""
+    xp = jnp if isinstance(x, jnp.ndarray) else np
+    u = xp.uint32
+    x = x.astype(u)
+    x = x ^ (x >> u(16))
+    x = x * u(0x7FEB352D)
+    x = x ^ (x >> u(15))
+    x = x * u(0x846CA68B)
+    x = x ^ (x >> u(16))
+    return x
+
+
+def _eff_u32(x):
+    """The signed-byte-plane linearization of a u32 coefficient.
+
+    Equals _effective_u32 (byte k >= 128 shifts the coefficient by
+    -2^(8k+8); the k=3 term wraps to zero mod 2^32) but computable
+    in-kernel without a table.
+    """
+    xp = jnp if isinstance(x, jnp.ndarray) else np
+    u = xp.uint32
+    return (
+        x
+        - (((x >> u(7)) & u(1)) << u(8))
+        - (((x >> u(15)) & u(1)) << u(16))
+        - (((x >> u(23)) & u(1)) << u(24))
+    )
+
+
 def _combine_planes_u32(planes) -> "jnp.ndarray | np.ndarray":
     """i32[..., 4] plane sums -> u32[...] hash (shared jnp/np semantics)."""
     xp = jnp if isinstance(planes, jnp.ndarray) else np
@@ -231,8 +275,13 @@ class Fingerprinter:
         self.P = P
 
         rng = np.random.default_rng(seed)
+        self.seed = np.uint32(seed)
         C = rng.integers(0, 1 << 32, size=(self.N_CHAN, F), dtype=np.uint32)
-        G = rng.integers(0, 1 << 32, size=(self.N_CHAN, M), dtype=np.uint32)
+        # message coefficients are COMPUTED (see _mix32 above) so successor
+        # kernels can evaluate them arithmetically; materialize the matrix
+        # host-side for the full-state matmul path.  raw_msg_coef is the
+        # single definition both paths share.
+        G = np.moveaxis(self.raw_msg_coef(np.arange(M, dtype=np.uint32)), -1, 0)
         if cfg.use_view:
             C[0:2, self.spec.F_view :] = 0  # aux vars excluded from view hash
 
@@ -253,14 +302,31 @@ class Fingerprinter:
         self.G_planes = jnp.asarray(
             _u32_to_i8_planes(Gp).transpose(2, 0, 1, 3).reshape(M, P * self.N_CHAN * 4)
         )
-        # Delta-gather table: u32[M+1, P, chan], row M = zeros (padding id).
-        gp_eff = _effective_u32(Gp)
-        gp_rows = np.concatenate(
-            [gp_eff.transpose(2, 0, 1), np.zeros((1, P, self.N_CHAN), np.uint32)]
-        )
-        self.G_rows = jnp.asarray(gp_rows)
+        # tiny constants for the arithmetic delta path
+        self._pair_perm = jnp.asarray(self.uni.pair_perm_table)  # [P, S(S-1)]
+        self._type_offsets = self.uni.type_offsets
+        self._type_strides = self.uni.type_strides
         # Host copies for the numpy reference path.
         self._Cp_np, self._Gp_np = Cp, Gp
+
+    # -- the ONE definition of the computed message coefficient ------------
+
+    def raw_msg_coef(self, ids):
+        """Message id(s) -> raw u32 coefficient per channel [..., chan].
+
+        ``G[c, m] = mix32(m*PHI + c*PHI2 + seed)`` — identical semantics
+        for numpy (host matrix build) and jnp (kernel arithmetic) inputs.
+        """
+        xp = jnp if isinstance(ids, jnp.ndarray) else np
+        chan_c = (
+            xp.arange(self.N_CHAN, dtype=xp.uint32) * xp.uint32(_PHI2)
+            + xp.uint32(self.seed)
+        )
+        return _mix32(ids.astype(xp.uint32)[..., None] * xp.uint32(_PHI) + chan_c)
+
+    def msg_coef_eff(self, ids):
+        """Byte-plane-linearized coefficient (what the delta paths add)."""
+        return _eff_u32(self.raw_msg_coef(ids))
 
     # -- jnp kernels -------------------------------------------------------
 
@@ -294,10 +360,47 @@ class Fingerprinter:
         Dead slots (live=False) contribute zero — used both for -1 padding
         and for re-sent messages already present in the parent set (set
         union adds nothing; see FollowerAcceptEntry, Raft.tla:292-295).
+
+        Entirely arithmetic: the permuted message id is reconstructed from
+        the mixed-radix layout (only the (src, dst) pair digit moves under
+        a server permutation) and the coefficient is the computed
+        ``mix32`` hash — no table gathers on the per-lane hot path.
         """
-        safe = jnp.where(live, ids, self.uni.M)
-        g = self.G_rows[safe]  # [..., A, P, chan]
-        return g.sum(axis=-3, dtype=jnp.uint32)
+        i32, u32 = jnp.int32, jnp.uint32
+        id0 = jnp.clip(ids, 0, self.uni.M - 1).astype(i32)  # [..., A]
+        # message type from the offset ranges (branchless)
+        offs = self._type_offsets
+        t = (
+            (id0 >= offs[1]).astype(i32)
+            + (id0 >= offs[2]).astype(i32)
+            + (id0 >= offs[3]).astype(i32)
+        )
+        # per-type decode with constant divisors, then select
+        pair = jnp.zeros_like(id0)
+        rest = jnp.zeros_like(id0)
+        off = jnp.zeros_like(id0)
+        for k, (o, s) in enumerate(zip(offs, self._type_strides)):
+            qk = id0 - i32(o)
+            pk = qk // i32(s)
+            sel = t == k
+            pair = jnp.where(sel, pk, pair)
+            rest = jnp.where(sel, qk - pk * i32(s), rest)
+            off = jnp.where(sel, i32(o), off)
+        # permuted pair digit via a one-hot contraction with the tiny
+        # [P, S(S-1)] map (NP <= 42 even at 7 servers)
+        NP = self._pair_perm.shape[1]
+        onehot = (pair[..., None] == jnp.arange(NP, dtype=i32)).astype(i32)
+        pair_p = jnp.einsum(
+            "...n,pn->...p", onehot, self._pair_perm
+        )  # [..., A, P]
+        stride = jnp.zeros_like(id0)
+        for k, s in enumerate(self._type_strides):
+            stride = jnp.where(t == k, i32(s), stride)
+        id_p = off[..., None] + pair_p * stride[..., None] + rest[..., None]
+        g = self.msg_coef_eff(id_p)  # [..., A, P, chan]
+        return jnp.where(
+            live[..., None, None], g, u32(0)
+        ).sum(axis=-3, dtype=jnp.uint32)
 
     @staticmethod
     def finalize(h: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:

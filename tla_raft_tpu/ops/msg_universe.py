@@ -65,6 +65,18 @@ class MsgUniverse:
         self.ap_off = self.aq_off + self.aq_size
         self.M = self.ap_off + self.ap_size
         self.n_words = (self.M + 31) // 32  # packed u32 width
+        # Every type's layout is id = off + pair*stride + rest with
+        # pair = (src-1)*(S-1) + dst_idx (src-major, see the encoders), so
+        # a server permutation moves only the pair digit: permuted id =
+        # off + pair_perm_table[p, pair]*stride + rest.  Kernels exploit
+        # this to permute message IDs arithmetically (no [P, M] gather).
+        self.type_offsets = (self.vq_off, self.vp_off, self.aq_off, self.ap_off)
+        self.type_strides = (
+            T * L * T,  # VoteReq block per (src, dst)
+            T,  # VoteResp
+            T * L * (T + 1) * self.n_entry * L,  # AppendReq
+            T * L * 2,  # AppendResp
+        )
 
         self._build_decode_tables()
 
@@ -255,6 +267,28 @@ class MsgUniverse:
                     (self.typ == APPEND_REQ) & (self.dst == s) & (self.term == t)
                 ).astype(np.uint8)
                 out[s - 1, t - 1] = self.pack_bits(bits)
+        return out
+
+    @functools.cached_property
+    def pair_perm_table(self) -> np.ndarray:
+        """int32[P, S*(S-1)]: the (src, dst)-pair digit under each perm.
+
+        pair_perm_table[p, (src-1)*(S-1)+dst_idx] is the pair digit of the
+        same message with src/dst remapped through permutation p — the
+        tiny table behind the arithmetic message-ID permutation
+        (see ``type_offsets``/``type_strides``).
+        """
+        S = self.S
+        perms = self.cfg.server_perms()
+        out = np.zeros((len(perms), S * (S - 1)), np.int32)
+        for pi, p in enumerate(perms):
+            for src in range(1, S + 1):
+                for di in range(S - 1):
+                    dst = _dst_from_idx(src, di)
+                    ns, nd = p[src - 1], p[dst - 1]
+                    out[pi, (src - 1) * (S - 1) + di] = (ns - 1) * (S - 1) + _dst_idx(
+                        ns, nd
+                    )
         return out
 
     @functools.cached_property

@@ -64,6 +64,7 @@ from ..config import (
     RaftConfig,
 )
 from ..models.raft import RaftState
+from .dense_expand import DenseExpand
 from .fingerprint import Fingerprinter, get_fingerprinter
 from .msg_universe import get_universe
 
@@ -243,8 +244,16 @@ class SuccessorKernel:
         self._slot_family_dev = jnp.asarray(self.slot_family)
         self._slot_coords_dev = jnp.asarray(self.slot_coords)
 
-        self.expand = jax.jit(self._expand)
+        # pass-1 expand: dense/tensorized formulation (ops/dense_expand.py);
+        # the scalar vmap formulation is kept as the differential reference
+        self.dense = DenseExpand(cfg, self.uni, self.fpr)
+        self.expand = jax.jit(self._expand_dense)
+        self.expand_reference = jax.jit(self._expand)
         self.materialize = jax.jit(self._materialize)
+
+    def _expand_dense(self, st: RaftState, msum: jnp.ndarray) -> Expansion:
+        valid, mult, fpv, fpf, abort = self.dense(st, msum)
+        return Expansion(valid, mult & jnp.where(valid, -1, 0), fpv, fpf, abort)
 
     # -- scalar action transcriptions -------------------------------------
     # Each takes (st: RaftState with no batch dim, c: i32[5]) and returns
