@@ -74,12 +74,20 @@ def main():
 
     # warm-up run compiles every kernel shape (cached persistently), then
     # the timed run measures steady-state throughput
-    chk = JaxChecker(cfg, chunk=chunk)
+    def progress(s):
+        print(
+            f"[bench] level {s['level']}: frontier {s['frontier']}, "
+            f"distinct {s['distinct']}, {s['distinct'] / max(s['elapsed'], 1e-9):,.0f}/s",
+            file=sys.stderr,
+        )
+        sys.stderr.flush()
+
+    chk = JaxChecker(cfg, chunk=chunk, progress=progress)
     t0 = time.monotonic()
     res = chk.run(max_depth=max_depth)
     dt = time.monotonic() - t0
     t1 = time.monotonic()
-    res2 = JaxChecker(cfg, chunk=chunk).run(max_depth=max_depth)
+    res2 = JaxChecker(cfg, chunk=chunk, progress=progress).run(max_depth=max_depth)
     dt2 = time.monotonic() - t1
     rate = res2.distinct / dt2
 

@@ -71,10 +71,11 @@ def trial(C, n_live, n_unique, vis_size, n_vis_hits, cap_x, tag):
     cv0, cf0, cp0, _ovf = _chunk_compact(
         jnp.asarray(fv), jnp.asarray(ff), jnp.asarray(fp), cap_x
     )
+    # NB: _level_dedup returns (n, view fps, payloads) — fp_full ordering
+    # is interior to the sort and validated by the engine parity tests
     n_dev, cv_d, cp_d = jax.device_get(
         _level_dedup(cv0, cf0, cp0, jnp.asarray(vis))
     )
-    cf_d = None
     n_ref, cv_r, cf_r, cp_r = ref_chunk(fv, ff, fp, vis, cap_x)
     ok = (
         int(n_dev) == n_ref

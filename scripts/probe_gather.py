@@ -62,9 +62,10 @@ M = fpr.uni.M
 ids = jnp.asarray(rng.integers(0, M + 1, (N, A)), jnp.int32)
 live = jnp.asarray(rng.random((N, A)) < 0.5)
 
-# 1. delta-hash gather as used in the kernel
+# 1. delta-hash as used in the kernel (now arithmetic mix32 — the table
+# gather it replaced measured ~57 ms standalone / ~750 GB reads fused)
 f1 = jax.jit(lambda ids, live: fpr.delta_hash(ids, live).sum())
-timeit("delta_hash rows G_rows[ids]  (2.8M ids)", lambda: f1(ids, live))
+timeit("delta_hash arithmetic (2.8M ids)", lambda: f1(ids, live))
 
 
 # 2. guard-table row gather (vq_uptodate) at 1.4M witness tuples

@@ -49,20 +49,6 @@ def timeit(label, fn, n=5):
     return dt
 
 
-def repeat_in_jit(op, x, R):
-    def body(_i, acc):
-        return acc + op(x + acc.astype(x.dtype)[..., :1] * 0)
-
-    # accumulate so the loop body cannot be hoisted/folded
-    def run(x):
-        def body(i, acc):
-            return acc + op(x + (acc % 2).astype(x.dtype))
-
-        return jax.lax.fori_loop(0, R, body, jnp.zeros((), jnp.int64))
-
-    return jax.jit(run)
-
-
 # 1. bandwidth sanity: elementwise on 100MB
 big = jnp.asarray(rng.integers(0, 255, (100 * 1024 * 1024,), np.uint8))
 f_bw = jax.jit(lambda x: (x * 2).sum(dtype=jnp.int64))

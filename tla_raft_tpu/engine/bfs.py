@@ -367,6 +367,14 @@ class JaxChecker:
             mult_acc = mult_acc + mult_slots
             abort_at = jnp.minimum(abort_at, ab_at)
             overflow = overflow | ovf
+        # pad the level-dedup input to a power-of-two lane count so its
+        # sort program compiles O(log) times per run, not once per level
+        n_lanes = len(cvs) * self.cap_x
+        pad = _pow2(n_lanes) - n_lanes
+        if pad:
+            cvs.append(jnp.full((pad,), SENT, U64))
+            cfs.append(jnp.full((pad,), SENT, U64))
+            cps.append(jnp.full((pad,), -1, I64))
         n_new_dev, new_fps, new_payload = _level_dedup(
             jnp.concatenate(cvs), jnp.concatenate(cfs), jnp.concatenate(cps),
             visited,
@@ -479,8 +487,9 @@ class JaxChecker:
             # compiled shape instead of one per pow2 frontier size.
             # Materialization runs in chunk-sized slices: msg_hash unpacks
             # a [n, n_words, 32] intermediate that would OOM at millions
-            # of survivors in one call.
-            cap_c = max(_cap4(n_new), self.chunk)
+            # of survivors in one call.  pow2 (not pow4) capacity: at
+            # multi-million frontiers a 4x overshoot is gigabytes.
+            cap_c = max(_pow2(n_new), self.chunk)
             pidx_np = pay_np // K
             slot_np = pay_np % K
             pidx = _pad_axis0(jnp.asarray(pidx_np, I64), cap_c)
@@ -511,9 +520,12 @@ class JaxChecker:
             )
 
             if self.host_store is None:
-                # merge, then trim the store to a pow2 capacity >= distinct
-                # (the merge input carries C-n_new sentinel padding slots)
-                visited = _merge_sorted(visited, new_fps)[: _cap4(distinct + 1)]
+                # merge, then trim the store to a pow4 capacity >= distinct;
+                # new_fps is survivor-compacted, so slicing to cap_c keeps
+                # every real fingerprint and bounds the sort input
+                visited = _merge_sorted(visited, new_fps[:cap_c])[
+                    : _cap4(distinct + 1)
+                ]
             frontier, msum, n_f = children, child_msum, n_new
 
             if self.progress is not None:

@@ -192,6 +192,37 @@ def _set2(mat: jnp.ndarray, i, j, val) -> jnp.ndarray:
     return jnp.where(mask, jnp.asarray(val).astype(mat.dtype), mat)
 
 
+# The matching scatter-free READS: the same backend charges a fixed
+# multi-ms penalty to any launched program containing a data-indexed
+# gather (docs/PERF.md), and the materialize pass runs per level on the
+# deduped survivors, so its per-lane state reads use masked reduces too.
+# (Reads that only feed guards/multiplicities stay as plain indexing:
+# materialize dead-code-eliminates them, and the scalar expand reference
+# is CPU-only.)
+
+
+def _get1(vec: jnp.ndarray, i) -> jnp.ndarray:
+    """vec[i] as a masked reduce (no gather); i32 scalar."""
+    return jnp.where(jnp.arange(vec.shape[0]) == i, vec.astype(I32), 0).sum(
+        dtype=I32
+    )
+
+
+def _get_row(mat: jnp.ndarray, i) -> jnp.ndarray:
+    """mat[i] (row) as a masked reduce; [n, m] -> i32[m]."""
+    return jnp.where(
+        (jnp.arange(mat.shape[0]) == i)[:, None], mat.astype(I32), 0
+    ).sum(0, dtype=I32)
+
+
+def _get2(mat: jnp.ndarray, i, j) -> jnp.ndarray:
+    """mat[i, j] as a masked reduce; i32 scalar."""
+    mask = (jnp.arange(mat.shape[0]) == i)[:, None] & (
+        jnp.arange(mat.shape[1]) == j
+    )[None, :]
+    return jnp.where(mask, mat.astype(I32), 0).sum(dtype=I32)
+
+
 def _any(msgs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.any((msgs & mask) != 0)
 
@@ -270,15 +301,14 @@ class SuccessorKernel:
         cfg, uni = self.cfg, self.uni
         S, T = cfg.S, cfg.T
         s = c[0]
-        ct = st.current_term.astype(I32)
-        role = st.role[s]
+        role = _get1(st.role, s)
         valid = (
             (st.election_count.astype(I32) < cfg.max_election)
             & ((role == FOLLOWER) | (role == CANDIDATE))
         )
-        new_term = jnp.clip(ct[s] + 1, 1, T)
-        ll = st.log_len.astype(I32)[s]
-        llt = jnp.clip(st.log_term.astype(I32)[s, ll - 1], 0, T - 1)
+        new_term = jnp.clip(_get1(st.current_term, s) + 1, 1, T)
+        ll = _get1(st.log_len, s)
+        llt = jnp.clip(_get2(st.log_term, s, jnp.clip(ll - 1, 0, None)), 0, T - 1)
         peers0 = (s + 1 + jnp.arange(S - 1, dtype=I32)) % S if S > 1 else jnp.zeros((1,), I32)
         ids = uni.encode_votereq(s + 1, peers0 + 1, new_term, ll, llt).astype(I32)
         added = jnp.full((self.A,), -1, I32).at[: ids.shape[0]].set(ids)
@@ -318,7 +348,7 @@ class SuccessorKernel:
         cfg, uni = self.cfg, self.uni
         T = cfg.T
         s, cand = c[0], c[1]
-        cur = st.current_term.astype(I32)[s]
+        cur = _get1(st.current_term, s)
         ll = st.log_len.astype(I32)[s]
         llt = jnp.clip(st.log_term.astype(I32)[s, ll - 1], 0, T)
         qual = self.tables.vq_uptodate[cand, s, jnp.clip(cur - 1, 0, None), llt, ll - 1]
@@ -351,7 +381,7 @@ class SuccessorKernel:
         cur = st.current_term.astype(I32)[s]
         votes = _popcount(st.msgs, self.tables.vp_to[s, jnp.clip(cur - 1, 0, None)])
         valid = (st.role[s] == CANDIDATE) & (votes + 1 >= cfg.majority)
-        ll = st.log_len[s]
+        ll = _get1(st.log_len, s).astype(U8)
         ar = jnp.arange(S)
         child = st._replace(
             role=_set1(st.role, s, LEADER),
@@ -365,18 +395,19 @@ class SuccessorKernel:
         cfg = self.cfg
         L = cfg.L
         s, v = c[0], c[1]
-        ll = st.log_len.astype(I32)[s]
+        ll = _get1(st.log_len, s)
         valid = (st.role[s] == LEADER) & (st.val_sent[v] == 0) & (ll < L)
         # append position: 0-based slot of TLA index ll+1
         at_w = jnp.arange(L, dtype=I32) == jnp.clip(ll, 0, L - 1)
+        lt_row = _get_row(st.log_term, s)
+        lv_row = _get_row(st.log_val, s)
         child = st._replace(
             val_sent=_set1(st.val_sent, v, 1),  # := FALSE, Raft.tla:237
             log_term=_set_row(
-                st.log_term, s, jnp.where(at_w, st.current_term[s], st.log_term[s])
+                st.log_term, s,
+                jnp.where(at_w, _get1(st.current_term, s), lt_row),
             ),
-            log_val=_set_row(
-                st.log_val, s, jnp.where(at_w, (v + 1).astype(U8), st.log_val[s])
-            ),
+            log_val=_set_row(st.log_val, s, jnp.where(at_w, v + 1, lv_row)),
             log_len=_set1(st.log_len, s, ll + 1),
             match_index=_set2(st.match_index, s, s, ll + 1),
         )
@@ -386,24 +417,27 @@ class SuccessorKernel:
         cfg, uni = self.cfg, self.uni
         T, L = cfg.T, cfg.L
         s, d = c[0], c[1]
-        ct = st.current_term.astype(I32)[s]
-        ni = st.next_index.astype(I32)[s, d]
-        ll = st.log_len.astype(I32)[s]
+        ct = _get1(st.current_term, s)
+        ni = _get2(st.next_index, s, d)
+        ll = _get1(st.log_len, s)
+        lt_row = _get_row(st.log_term, s)
+        lv_row = _get_row(st.log_val, s)
         pli = jnp.clip(ni - 1, 1, L)
-        plt = jnp.clip(st.log_term.astype(I32)[s, jnp.clip(ni - 2, 0, L - 1)], 0, T)
+        oh_prev = jnp.arange(L, dtype=I32) == jnp.clip(ni - 2, 0, L - 1)
+        plt = jnp.clip((oh_prev * lt_row).sum(dtype=I32), 0, T)
         has_entry = ni <= ll
-        epos = jnp.clip(ni - 1, 0, L - 1)
+        oh_epos = jnp.arange(L, dtype=I32) == jnp.clip(ni - 1, 0, L - 1)
         ecode = jnp.where(
             has_entry,
             self.uni.entry_code(
-                jnp.clip(st.log_term.astype(I32)[s, epos], 1, T),
-                jnp.clip(st.log_val.astype(I32)[s, epos], 1, cfg.V),
+                jnp.clip((oh_epos * lt_row).sum(dtype=I32), 1, T),
+                jnp.clip((oh_epos * lv_row).sum(dtype=I32), 1, cfg.V),
             ),
             0,
         )
         mid = uni.encode_appendreq(
             s + 1, d + 1, jnp.clip(ct, 1, T), pli, plt, ecode,
-            st.commit_index.astype(I32)[s],
+            _get1(st.commit_index, s),
         ).astype(I32)
         valid = (
             (st.role[s] == LEADER)
@@ -419,11 +453,13 @@ class SuccessorKernel:
         cfg, uni = self.cfg, self.uni
         T, L, V = cfg.T, cfg.L, cfg.V
         s, src, pli, e, lc = c[0], c[1], c[2] + 1, c[3], c[4] + 1
-        cur = st.current_term.astype(I32)[s]
-        ll = st.log_len.astype(I32)[s]
-        lt = st.log_term.astype(I32)[s]
-        lv = st.log_val.astype(I32)[s]
-        plt = jnp.clip(lt[jnp.clip(pli - 1, 0, L - 1)], 0, T)
+        cur = _get1(st.current_term, s)
+        ll = _get1(st.log_len, s)
+        lt = _get_row(st.log_term, s)
+        lv = _get_row(st.log_val, s)
+        ar = jnp.arange(L, dtype=I32)
+        oh_prev = ar == jnp.clip(pli - 1, 0, L - 1)
+        plt = jnp.clip((oh_prev * lt).sum(dtype=I32), 0, T)
         mid = uni.encode_appendreq(
             src + 1, s + 1, jnp.clip(cur, 1, T), pli, plt, e, lc
         ).astype(I32)
@@ -438,22 +474,29 @@ class SuccessorKernel:
         new_len = pli + el
         append_new = new_len > ll
         pos = jnp.clip(pli, 0, L - 1)  # 0-based slot of the carried entry
-        conflict = (el == 1) & (pli < ll) & ((lt[pos] != eterm) | (lv[pos] != eval_))
+        oh_pos = ar == pos
+        conflict = (
+            (el == 1)
+            & (pli < ll)
+            & (
+                ((oh_pos * lt).sum(dtype=I32) != eterm)
+                | ((oh_pos * lv).sum(dtype=I32) != eval_)
+            )
+        )
         updated = append_new | conflict
-        ar = jnp.arange(L, dtype=I32)
         keep = ar < pli
-        at_entry = (ar == pos) & (el == 1)
-        new_lt = jnp.where(keep, st.log_term[s], U8(0))
-        new_lt = jnp.where(at_entry, eterm.astype(U8), new_lt)
-        new_lv = jnp.where(keep, st.log_val[s], U8(0))
-        new_lv = jnp.where(at_entry, eval_.astype(U8), new_lv)
+        at_entry = oh_pos & (el == 1)
+        new_lt = jnp.where(keep, lt, 0)
+        new_lt = jnp.where(at_entry, eterm, new_lt)
+        new_lv = jnp.where(keep, lv, 0)
+        new_lv = jnp.where(at_entry, eval_, new_lv)
         child = st._replace(
-            log_term=_set_row(st.log_term, s, jnp.where(updated, new_lt, st.log_term[s])),
-            log_val=_set_row(st.log_val, s, jnp.where(updated, new_lv, st.log_val[s])),
+            log_term=_set_row(st.log_term, s, jnp.where(updated, new_lt, lt)),
+            log_val=_set_row(st.log_val, s, jnp.where(updated, new_lv, lv)),
             log_len=_set1(st.log_len, s, jnp.where(updated, new_len, ll)),
             commit_index=_set1(
                 st.commit_index, s,
-                jnp.maximum(st.commit_index.astype(I32)[s], jnp.minimum(lc, new_len)),
+                jnp.maximum(_get1(st.commit_index, s), jnp.minimum(lc, new_len)),
             ),
         )
         resp = uni.encode_appendresp(
@@ -465,7 +508,7 @@ class SuccessorKernel:
         cfg, uni = self.cfg, self.uni
         T, L = cfg.T, cfg.L
         s, src, pli = c[0], c[1], c[2] + 1
-        cur = st.current_term.astype(I32)[s]
+        cur = _get1(st.current_term, s)
         ll = st.log_len.astype(I32)[s]
         tix = jnp.clip(cur - 1, 0, None)
         block = self.tables.aq_block[src, s, tix, pli - 1]
@@ -485,12 +528,12 @@ class SuccessorKernel:
         cfg, uni = self.cfg, self.uni
         T = cfg.T
         s, src, pli, sc = c[0], c[1], c[2] + 1, c[3]
-        cur = st.current_term.astype(I32)[s]
+        cur = _get1(st.current_term, s)
         mid = uni.encode_appendresp(
             src + 1, s + 1, jnp.clip(cur, 1, T), pli, sc
         ).astype(I32)
-        mi = st.match_index.astype(I32)[s, src]
-        ni = st.next_index.astype(I32)[s, src]
+        mi = _get2(st.match_index, s, src)
+        ni = _get2(st.next_index, s, src)
         base = (
             (st.role[s] == LEADER) & (cur >= 1) & (src != s)
             & (st.pending[s, src] == 1) & _bit_get(st.msgs, mid)
@@ -506,10 +549,17 @@ class SuccessorKernel:
 
     def _leader_can_commit(self, st: RaftState, c):
         cfg = self.cfg
+        S = cfg.S
         s = c[0]
-        row = jnp.sort(st.match_index.astype(I32)[s])
-        med = row[cfg.median_index]  # Median(F), Raft.tla:70-75 (or mutation)
-        valid = (st.role[s] == LEADER) & (med > st.commit_index.astype(I32)[s])
+        # Median(F), Raft.tla:70-75 (or the median-bug mutation): the
+        # median_index-th order statistic via rank-select, no sort op
+        row = _get_row(st.match_index, s)
+        ar = jnp.arange(S)
+        pos = (row[None, :] < row[:, None]).sum(-1, dtype=I32) + (
+            (row[None, :] == row[:, None]) & (ar[None, :] < ar[:, None])
+        ).sum(-1, dtype=I32)
+        med = (row * (pos == cfg.median_index)).sum(dtype=I32)
+        valid = (st.role[s] == LEADER) & (med > _get1(st.commit_index, s))
         child = st._replace(commit_index=_set1(st.commit_index, s, med))
         return valid, I32(1), child, self._no_add(), False
 
@@ -562,8 +612,11 @@ class SuccessorKernel:
     # -- pass 2: materialize surviving slots ------------------------------
 
     def _materialize_one(self, st: RaftState, slot: jnp.ndarray) -> RaftState:
-        fam = self._slot_family_dev[slot]
-        coords = self._slot_coords_dev[slot]
+        # slot -> (family, coords) via one-hot contraction over the K-row
+        # constants (a per-lane gather would hit the slow-gather path)
+        oh_slot = (jnp.arange(self.K) == slot).astype(I32)
+        fam = (oh_slot * self._slot_family_dev).sum(dtype=I32)
+        coords = (oh_slot[:, None] * self._slot_coords_dev).sum(0, dtype=I32)
 
         def mk(fn):
             def branch(args):
