@@ -1,15 +1,15 @@
 """Diagnostic 6: replay the engine's own level pipeline TPU-vs-CPU.
 
 Phase "save" (run with --cpu): run JaxChecker on CPU (proven bit-exact vs
-the oracle) and record every level's pipeline inputs (frontier arrays,
-msum, n_f, visited) and outputs (n_new, new_fps, new_payload) to an .npz.
+the oracle) and record every level's pipeline inputs (compact frontier,
+n_f, visited) and outputs (n_new, new_fps, new_payload) to an .npz.
 
 Phase "check" (run on the TPU): load each level's *CPU-produced* inputs,
-run the same `_expand_level` (fused expand + two-stage dedup programs),
-and compare outputs lane by lane.  The first diverging level/lane
-localizes the platform miscompile with real data and the real fused
-programs — scripts/diag_expand_tpu.py already proved standalone expand
-clean, so the divergence lives in program fusion or the dedup chain.
+run the same `_expand_level` (fused inflate + expand + compaction + dedup
+programs), and compare outputs lane by lane; then replay the materialize
+chain (`_mat_slice`) and compare the produced compact children against
+the next recorded frontier.  The first diverging level/lane localizes a
+platform miscompile with real data and the real fused programs.
 
 Usage:
   PYTHONPATH=. python scripts/diag_engine_tpu.py save [depth] [chunk] --cpu
@@ -40,7 +40,7 @@ import numpy as np
 
 from tla_raft_tpu.cfgparse import load_raft_config
 from tla_raft_tpu.engine import JaxChecker
-from tla_raft_tpu.models.raft import RaftState
+from tla_raft_tpu.engine.bfs import Frontier, I64
 
 PATH = "/tmp/diag_engine_levels.npz"
 cfg = load_raft_config("/root/reference/Raft.cfg")
@@ -52,13 +52,12 @@ records = []
 orig = JaxChecker._expand_level
 
 
-def recording(self, frontier, msum, n_f, visited):
-    out = orig(self, frontier, msum, n_f, visited)
-    n_new, new_fps, new_payload, abort_at, overflow, mult = out
+def recording(self, frontier, n_f, visited):
+    out = orig(self, frontier, n_f, visited)
+    n_new, new_fps, new_payload, abort_at, overflow, overflow_g, mult = out
     records.append(
         dict(
             frontier={k: np.asarray(v) for k, v in frontier._asdict().items()},
-            msum=np.asarray(msum),
             n_f=n_f,
             visited=np.asarray(visited),
             n_new=n_new,
@@ -72,14 +71,12 @@ def recording(self, frontier, msum, n_f, visited):
 
 if mode == "save":
     chk._expand_level = recording.__get__(chk)
-    # NB: JaxChecker.run binds self._expand_level? (it calls self._expand_level)
     res = chk.run(max_depth=depth)
     print("CPU run:", res.level_sizes, "ok", res.ok)
     flat = {}
     for li, r in enumerate(records):
         for k, v in r["frontier"].items():
             flat[f"l{li}_st_{k}"] = v
-        flat[f"l{li}_msum"] = r["msum"]
         flat[f"l{li}_nf"] = np.asarray([r["n_f"]])
         flat[f"l{li}_visited"] = r["visited"]
         flat[f"l{li}_nnew"] = np.asarray([r["n_new"]])
@@ -98,25 +95,28 @@ print(f"replaying {n_levels} recorded levels")
 fields = [k[len("l0_st_"):] for k in z.files if k.startswith("l0_st_")]
 first_bad = None
 for li in range(n_levels):
-    frontier = RaftState(**{f: jnp.asarray(z[f"l{li}_st_{f}"]) for f in fields})
-    msum = jnp.asarray(z[f"l{li}_msum"])
+    frontier = Frontier(**{f: jnp.asarray(z[f"l{li}_st_{f}"]) for f in fields})
     n_f = int(z[f"l{li}_nf"][0])
     visited = jnp.asarray(z[f"l{li}_visited"])
     want_n = int(z[f"l{li}_nnew"][0])
     want_fps = z[f"l{li}_newfps"]
     want_pay = z[f"l{li}_newpay"]
     want_mult = z[f"l{li}_mult"]
-    n_new, new_fps, new_payload, abort_at, overflow, mult = chk._expand_level(
-        frontier, msum, n_f, visited
+    n_new, new_fps, new_payload, abort_at, overflow, overflow_g, mult = chk._expand_level(
+        frontier, n_f, visited
     )
     new_fps = np.asarray(new_fps)
     new_payload = np.asarray(new_payload)
-    ok_n = n_new == want_n
     lim = min(n_new, want_n)
     fps_diff = np.nonzero(new_fps[:lim] != want_fps[:lim])[0]
     pay_diff = np.nonzero(new_payload[:lim] != want_pay[:lim])[0]
     mult_diff = np.nonzero(np.asarray(mult) != want_mult)[0]
-    status = "OK" if (ok_n and not len(fps_diff) and not len(pay_diff) and not len(mult_diff)) else "DIVERGED"
+    status = (
+        "OK"
+        if (n_new == want_n and not len(fps_diff) and not len(pay_diff)
+            and not len(mult_diff))
+        else "DIVERGED"
+    )
     print(
         f"level {li}: n_f={n_f} n_new dev={n_new} want={want_n} "
         f"fp_diffs={len(fps_diff)} pay_diffs={len(pay_diff)} "
@@ -134,81 +134,40 @@ for li in range(n_levels):
             )
         for d in mult_diff[:5]:
             print(f"  mult slot {d}: dev {int(np.asarray(mult)[d])} want {int(want_mult[d])}")
-        # localize per chunk: run each chunk's fused program and also its
-        # pieces (expand jit alone, then compaction on numpy-side masks)
-        from tla_raft_tpu.engine.bfs import I64, SENT, _chunk_compact
-
-        cap_f = frontier.voted_for.shape[0]
-        for start in range(0, min(cap_f, max(n_f, 1)), chunk):
-            part = jax.tree.map(
-                lambda x: jax.lax.dynamic_slice_in_dim(
-                    x, start, min(chunk, cap_f - start), 0
-                ),
-                frontier,
-            )
-            cv, cf_, cp, mult_slots, ab, ovf = chk._expand_chunk(
-                part, msum[start : start + chunk], jnp.asarray(start, I64),
-                jnp.asarray(n_f, I64),
-            )
-            # piecewise: standalone expand (proven clean) + standalone compact
-            exp = chk.kern.expand(part, msum[start : start + chunk])
-            K = chk.K
-            in_range = (start + np.arange(part.voted_for.shape[0]) < n_f)[:, None]
-            valid = np.asarray(exp.valid) & in_range
-            fpv = np.where(valid, np.asarray(exp.fp_view), np.uint64(SENT)).ravel()
-            fpf = np.where(valid, np.asarray(exp.fp_full), np.uint64(SENT)).ravel()
-            base = ((start + np.arange(part.voted_for.shape[0])) * K)[:, None]
-            payload = (base + np.arange(K)[None]).ravel()
-            cv2, cf2, cp2, ovf2 = _chunk_compact(
-                jnp.asarray(fpv), jnp.asarray(fpf), jnp.asarray(payload), chk.cap_x
-            )
-            same = np.array_equal(np.asarray(cv), np.asarray(cv2)) and np.array_equal(
-                np.asarray(cp), np.asarray(cp2)
-            )
-            print(f"  chunk@{start}: fused-vs-piecewise match={same}")
-            if not same:
-                dcv = np.asarray(cv); dcv2 = np.asarray(cv2)
-                bad = np.nonzero(dcv != dcv2)[0][:5]
-                for b in bad:
-                    print(f"    lane {b}: fused {hex(int(dcv[b]))} piecewise {hex(int(dcv2[b]))}")
 print("first diverged level:", first_bad)
 
 # ---- pass 2: materialize chain ------------------------------------------
-# level li+1's recorded frontier/msum IS the CPU's _gather_mat output for
-# level li's survivors; recompute it on this backend and diff exactly.
-from tla_raft_tpu.engine.bfs import I64, _cap4, _pad_axis0
-
-print("\nmaterialize chain (dev _gather_mat vs recorded next frontier):")
+# level li+1's recorded frontier IS the CPU's materialize output for level
+# li's survivors; recompute it on this backend and diff exactly.
+print("\nmaterialize chain (dev _mat_slice vs recorded next frontier):")
 for li in range(n_levels - 1):
-    frontier = RaftState(**{f: jnp.asarray(z[f"l{li}_st_{f}"]) for f in fields})
+    frontier = Frontier(**{f: jnp.asarray(z[f"l{li}_st_{f}"]) for f in fields})
     n_new = int(z[f"l{li}_nnew"][0])
-    pay = z[f"l{li}_newpay"][:n_new]
-    cap_c = max(_cap4(n_new), chunk)
-    pidx = _pad_axis0(jnp.asarray(pay // chk.K, I64), cap_c)
-    slots = _pad_axis0(jnp.asarray(pay % chk.K, I64), cap_c)
-    children, child_msum = chk._gather_mat(frontier, pidx, slots)
+    pay = jnp.asarray(z[f"l{li}_newpay"])
+    sl = 4 * chunk
+    parts = []
+    for off in range(0, n_new, sl):
+        take = min(sl, n_new - off)
+        pay_slice = jax.lax.dynamic_slice_in_dim(pay, off, sl)
+        ch_f, _bad, _ovf = chk._mat_slice(frontier, pay_slice, jnp.asarray(take, I64))
+        parts.append(jax.tree.map(lambda x: np.asarray(x)[:take], ch_f))
+    got = jax.tree.map(lambda *xs: np.concatenate(xs), *parts)
     bad_fields = []
     for f in fields:
-        got = np.asarray(getattr(children, f))[:n_new]
+        g = getattr(got, f)[:n_new]
         want = z[f"l{li + 1}_st_{f}"][:n_new]
-        n_bad = int((got != want).any(axis=tuple(range(1, got.ndim))).sum()) if got.ndim > 1 else int((got != want).sum())
+        n_bad = int(
+            (g != want).reshape(n_new, -1).any(axis=1).sum()
+        )
         if n_bad:
             bad_fields.append((f, n_bad))
-    msum_got = np.asarray(child_msum)[:n_new]
-    msum_want = z[f"l{li + 1}_msum"][:n_new]
-    msum_bad = int((msum_got != msum_want).any(axis=(1, 2)).sum())
-    status = "OK" if not bad_fields and not msum_bad else "DIVERGED"
-    print(f"  level {li}->{li + 1}: n={n_new} bad_fields={bad_fields} msum_bad_rows={msum_bad} [{status}]")
+    status = "OK" if not bad_fields else "DIVERGED"
+    print(f"  level {li}->{li + 1}: n={n_new} bad_fields={bad_fields} [{status}]")
     if status == "DIVERGED":
-        for f, _n in bad_fields[:2]:
-            got = np.asarray(getattr(children, f))[:n_new]
-            want = z[f"l{li + 1}_st_{f}"][:n_new]
-            rows = np.nonzero((got != want).reshape(n_new, -1).any(axis=1))[0][:3]
-            for r in rows:
-                print(f"    field {f} row {r} (pay p={pay[r] // chk.K} s={pay[r] % chk.K}):")
-                print(f"      dev  {got[r].ravel()}")
-                print(f"      want {want[r].ravel()}")
-        if msum_bad:
-            rows = np.nonzero((msum_got != msum_want).any(axis=(1, 2)))[0][:3]
-            print(f"    msum bad rows: {rows}")
+        f, _n = bad_fields[0]
+        g = getattr(got, f)[:n_new]
+        want = z[f"l{li + 1}_st_{f}"][:n_new]
+        rows = np.nonzero((g != want).reshape(n_new, -1).any(axis=1))[0][:3]
+        for r in rows:
+            print(f"    field {f} row {r}: dev {g[r].ravel()} want {want[r].ravel()}")
         break

@@ -66,9 +66,10 @@ for B in (256, 1024, 2048):
     print(f"    -> {t / B * 1e6:.1f} us/state")
     from tla_raft_tpu.engine.bfs import I64
 
+    fr, _ovf = jax.jit(chk._deflate)(batch)
     t = timeit(
-        f"expand+compact fused B={B}",
-        lambda: chk._expand_chunk(batch, msum, jnp.asarray(0, I64), jnp.asarray(B, I64)),
+        f"inflate+expand+compact fused B={B}",
+        lambda: chk._expand_chunk(fr, jnp.asarray(0, I64), jnp.asarray(B, I64)),
         n=5,
     )
     print(f"    -> {t / B * 1e6:.1f} us/state")

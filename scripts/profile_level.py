@@ -52,16 +52,14 @@ chk2 = JaxChecker(cfg, chunk=chunk)
 
 
 # re-run capturing the last level's inputs
-def cap_expand(frontier, msum, n_f, visited):
-    state.update(frontier=frontier, msum=msum, n_f=n_f, visited=visited)
-    return JaxChecker._expand_level(chk2, frontier, msum, n_f, visited)
+def cap_expand(frontier, n_f, visited):
+    state.update(frontier=frontier, n_f=n_f, visited=visited)
+    return JaxChecker._expand_level(chk2, frontier, n_f, visited)
 
 
 chk2._expand_level = cap_expand
 res2 = chk2.run(max_depth=depth)
-frontier, msum, n_f, visited = (
-    state["frontier"], state["msum"], state["n_f"], state["visited"],
-)
+frontier, n_f, visited = state["frontier"], state["n_f"], state["visited"]
 print(f"captured level input: n_f={n_f}, visited cap={visited.shape[0]}")
 
 # --- stage timing ---------------------------------------------------------
@@ -85,12 +83,10 @@ print(f"level with {len(starts)} chunks of {chunk} (K={chk2.K}):")
 
 def one_chunk(start):
     part = jax.tree.map(
-        lambda x: jax.lax.dynamic_slice_in_dim(x, start, min(chunk, cap_f - start), 0),
-        frontier,
+        lambda x: jax.lax.dynamic_slice_in_dim(x, start, chunk), frontier
     )
     return chk2._expand_chunk(
-        part, msum[start : start + chunk], jnp.asarray(start, I64),
-        jnp.asarray(n_f, I64),
+        part, jnp.asarray(start, I64), jnp.asarray(n_f, I64)
     )
 
 
@@ -113,13 +109,20 @@ n_new_dev, new_fps, new_payload = _level_dedup(cvs, cfs, cps, visited)
 timeit("host fetch n_new", lambda: int(n_new_dev))
 n_new = int(n_new_dev)
 print(f"  n_new = {n_new}")
-pay_np = np.asarray(new_payload[:n_new])
-from tla_raft_tpu.engine.bfs import _cap4, _pad_axis0
+sl = 4 * chunk
 
-cap_c = max(_cap4(n_new), chunk)
-pidx = _pad_axis0(jnp.asarray(pay_np // chk2.K, I64), cap_c)
-slots = _pad_axis0(jnp.asarray(pay_np % chk2.K, I64), cap_c)
-timeit("materialize survivors", lambda: chk2._gather_mat(frontier, pidx, slots))
-children, child_msum = chk2._gather_mat(frontier, pidx, slots)
-timeit("invariant scan", lambda: chk2._inv_scan(children, jnp.asarray(n_new, I64)))
+
+def mat_all():
+    outs = []
+    for off in range(0, n_new, sl):
+        take = min(sl, n_new - off)
+        pay_slice = jax.lax.dynamic_slice_in_dim(new_payload, off, sl)
+        outs.append(
+            chk2._mat_slice(frontier, pay_slice, jnp.asarray(take, I64))
+        )
+    jax.block_until_ready(outs)
+    return outs
+
+
+timeit("materialize+inv+deflate (device)", mat_all, n=1)
 timeit("visited merge", lambda: _merge_sorted(visited, new_fps))

@@ -40,6 +40,41 @@ def test_full_run_parity(cfg):
     assert got.action_counts == want.action_counts
 
 
+def test_full_run_parity_grouped_and_sliced():
+    """Exercise the large-scale machinery at small scale: tiny chunks force
+    many chunks per level (group visited-filtering, n_chunks > 4*G) and
+    multi-slice materialization (n_new > 4*chunk), which production sweeps
+    hit at millions of states but the default-chunk tests never reach."""
+    cfg = RaftConfig(n_servers=3, n_vals=1, max_election=2, max_restart=1)
+    want = OracleChecker(cfg).run()
+    chk = JaxChecker(cfg, chunk=4)
+    chk.G = 2  # groups of 2 chunks -> grouping beyond 8 chunks
+    chk.cap_g = chk.G * chk.cap_x // 2
+    got = chk.run()
+    assert got.ok == want.ok
+    assert got.distinct == want.distinct
+    assert got.generated == want.generated
+    assert got.level_sizes == want.level_sizes
+    assert got.action_counts == want.action_counts
+
+
+def test_violation_found_across_materialize_slices():
+    """A violation in a later materialize slice must surface with the
+    correct global index and a genuine trace."""
+    cfg = RaftConfig(
+        n_servers=3, n_vals=1, max_election=1, max_restart=0,
+        invariants=("~RaftCanCommt",),
+    )
+    want = OracleChecker(cfg).run()
+    got = JaxChecker(cfg, chunk=4).run()
+    assert not got.ok and not want.ok
+    assert got.depth == want.depth
+    kind, trace = got.violation
+    assert "RaftCanCommt" in kind
+    for (_, a), (act, b) in zip(trace, trace[1:]):
+        assert any(ch == b for _n, _s, _d, ch in successors(cfg, a)), act
+
+
 def test_probe_violation_and_trace():
     """Running a probe's negation finds a violation at the oracle's depth,
     and the reported trace is a genuine behavior of the spec."""

@@ -5,7 +5,16 @@ loop, worker pool, FPSet dedup table, invariant evaluation, trace
 reconstruction and checkpointing of the external TLC jar driven by
 /root/reference/myrun.sh:3) with:
 
-* a **frontier** of full states held as padded struct-of-array tensors,
+* a **device-resident compact frontier**: full states minus the message
+  bitmask, which is stored as a sparse id list (``msg_ids``) — a
+  reachable state carries at most a few dozen of the universe's
+  thousands of message bits, so the sparse form is ~3x smaller
+  (~250 B/state), which is what lets multi-million-state frontiers and
+  their children coexist in HBM.  Chunks inflate ids -> bitmask on
+  device (scatter-free one-hot OR); materialized children deflate via a
+  ``top_k`` bit-position extraction.  Nothing state-sized ever crosses
+  the host link (measured at only ~2-20 MB/s on the tunneled device —
+  streaming states through the host cost ~100 us/state),
 * the successor kernel's masked fan-out (ops/successor.py) run in chunks,
 * **compact-then-dedup**, all on device:
     1. per chunk: a ``top_k`` partial sort compacts the ~0.5%-dense valid
@@ -62,6 +71,7 @@ from .invariants import resolve_invariant_kernel
 U64 = jnp.uint64
 I64 = jnp.int64
 I32 = jnp.int32
+U32C = jnp.uint32
 SENT = jnp.uint64(0xFFFFFFFFFFFFFFFF)
 BIG = jnp.int64(1 << 62)
 
@@ -76,6 +86,30 @@ class CheckResult(NamedTuple):
     level_sizes: tuple[int, ...]
     violation: tuple | None  # (kind, trace=[(action, OState), ...])
     action_counts: dict | None = None  # TLC -coverage analog (see oracle)
+
+
+class Frontier(NamedTuple):
+    """Compact device frontier: RaftState minus ``msgs``, plus sparse ids.
+
+    ``msg_ids``: ascending message ids, -1 padded, width ``cap_m``."""
+
+    voted_for: jnp.ndarray
+    current_term: jnp.ndarray
+    role: jnp.ndarray
+    log_term: jnp.ndarray
+    log_val: jnp.ndarray
+    log_len: jnp.ndarray
+    match_index: jnp.ndarray
+    next_index: jnp.ndarray
+    commit_index: jnp.ndarray
+    election_count: jnp.ndarray
+    restart_count: jnp.ndarray
+    pending: jnp.ndarray
+    val_sent: jnp.ndarray
+    msg_ids: jnp.ndarray
+
+
+_CORE_FIELDS = [f for f in RaftState._fields if f != "msgs"]
 
 
 def _pow2(n: int) -> int:
@@ -98,8 +132,6 @@ def _pad_axis0(x: jnp.ndarray, cap: int) -> jnp.ndarray:
     return jnp.concatenate([x, jnp.zeros((pad, *x.shape[1:]), x.dtype)])
 
 
-def _pad_tree(st: RaftState, cap: int) -> RaftState:
-    return jax.tree.map(lambda x: _pad_axis0(x, cap), st)
 
 
 @functools.partial(jax.jit, static_argnames=("cap_x",))
@@ -125,6 +157,33 @@ def _chunk_compact(fps_view, fps_full, payload, cap_x: int):
         jnp.where(lane, fps_full[idx], SENT),
         jnp.where(lane, payload[idx], -1),
         n_live > cap_x,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cap_g",))
+def _group_filter(cv, cf, cp, visited, cap_g: int):
+    """Drop already-visited candidates from a group of chunks and compact.
+
+    At deep levels ~85-90% of candidate lanes are revisits of the sorted
+    store; filtering a fixed-size group before the level-wide sort keeps
+    that sort (and its working set) proportional to the NEW states, not
+    the whole fan-out.  Dropping a visited view fingerprint removes its
+    whole candidate group, so downstream representative choice is
+    unaffected; compaction preserves lane order (stable top_k key).
+    """
+    C = cv.shape[0]
+    pos = jnp.searchsorted(visited, cv)
+    hit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == cv
+    keep = (cv != SENT) & ~hit
+    n = keep.sum()
+    key = jnp.where(keep, C - jnp.arange(C, dtype=I32), 0)
+    vals, idx = jax.lax.top_k(key, cap_g)
+    lane = vals > 0
+    return (
+        jnp.where(lane, cv[idx], SENT),
+        jnp.where(lane, cf[idx], SENT),
+        jnp.where(lane, cp[idx], -1),
+        n > cap_g,
     )
 
 
@@ -182,11 +241,17 @@ class JaxChecker:
         cap_x: int | None = None,
         progress: Callable[[dict], None] | None = None,
         host_store=None,
+        cap_m: int = 96,
     ):
         self.cfg = cfg
         self.kern: SuccessorKernel = get_kernel(cfg)
         self.fpr = self.kern.fpr
         self.K = self.kern.K
+        self.uni_words = self.kern.uni.n_words
+        # sparse-frontier width: max message-set size per reachable state
+        # (grows ~1/level; a run raises cleanly on overflow — bump cap_m)
+        self.cap_m = min(cap_m, self.kern.uni.M)
+        self.id_dtype = jnp.int16 if self.kern.uni.M < (1 << 15) else jnp.int32
         if chunk & (chunk - 1):
             # power-of-two capacities divide evenly into the pow4-padded
             # materialize buffer; arbitrary chunks would mis-slice it
@@ -196,6 +261,11 @@ class JaxChecker:
         # reference config, so chunk*4 covers the mean and overflow
         # detection grows the budget (with a re-jit) on skewed chunks
         self.cap_x = cap_x or 4 * chunk
+        # chunks per visited-filter group, and the per-group post-filter
+        # survivor budget (deep levels see <=50% fresh candidates;
+        # overflow grows cap_g like cap_x)
+        self.G = 16
+        self.cap_g = self.G * self.cap_x // 2
         self.progress = progress
         # optional native external-memory visited store (native/fpstore.cpp);
         # when set, the device keeps no visited table at all — the level's
@@ -204,20 +274,69 @@ class JaxChecker:
         self.inv_fns = [
             (n, resolve_invariant_kernel(n)) for n in cfg.invariants
         ]
-        self._gather_mat = jax.jit(self._gather_materialize)
+        self._mat_slice = jax.jit(self._mat_slice_impl)
         self._expand_chunk = jax.jit(self._expand_chunk_impl)
         self._inv_scan = jax.jit(self._inv_scan_impl)
 
+    # -- sparse <-> dense message-set conversion ---------------------------
+
+    def _ids_to_msgs(self, ids: jnp.ndarray) -> jnp.ndarray:
+        """msg_ids [n, cap_m] -> packed u32 [n, n_words] (scatter-free).
+
+        Ids are unique per state, so the per-word sum of one-hot bit
+        contributions equals the bitwise OR.
+        """
+        n_words = self.uni_words
+        idi = ids.astype(I32)
+        live = idi >= 0
+        w = jnp.clip(idi, 0, None) >> 5
+        bit = jnp.where(live, U32C(1) << (idi & 31).astype(jnp.uint32), U32C(0))
+        hit = jnp.arange(n_words, dtype=I32)[None, None, :] == w[:, :, None]
+        return (jnp.where(hit, bit[:, :, None], U32C(0))).sum(1, dtype=jnp.uint32)
+
+    def _msgs_to_ids(self, msgs: jnp.ndarray):
+        """packed u32 [n, n_words] -> (ids [n, cap_m] ascending -1-padded,
+        overflow bool): top_k over bit-position keys."""
+        M = self.kern.uni.M
+        bits = self.fpr.unpack_bits(msgs).astype(I32)
+        key = bits * (M - jnp.arange(M, dtype=I32))
+        vals, _ = jax.lax.top_k(key, self.cap_m)
+        ids = jnp.where(vals > 0, M - vals, -1)
+        ovf = bits.sum(-1, dtype=I32).max() > self.cap_m
+        return ids.astype(self.id_dtype), ovf
+
+    def _inflate(self, fr: Frontier) -> RaftState:
+        """Frontier chunk -> full RaftState with the packed bitmask."""
+        core = {f: getattr(fr, f) for f in _CORE_FIELDS}
+        return RaftState(msgs=self._ids_to_msgs(fr.msg_ids), **core)
+
+    def _deflate(self, st: RaftState):
+        core = {f: getattr(st, f) for f in _CORE_FIELDS}
+        ids, ovf = self._msgs_to_ids(st.msgs)
+        return Frontier(msg_ids=ids, **core), ovf
+
     # -- device helpers ----------------------------------------------------
 
-    def _gather_materialize(self, frontier: RaftState, pidx, slots):
-        parents = jax.tree.map(lambda x: x[pidx], frontier)
-        children = self.kern.materialize(parents, slots)
-        msum = self.fpr.msg_hash(children.msgs)
-        return children, msum
+    def _mat_slice_impl(self, frontier: Frontier, pay, n_valid):
+        """Materialize one survivor payload slice, entirely on device.
 
-    def _expand_chunk_impl(self, part: RaftState, msum_part, start, n_f):
-        """One chunk: expand + mask + valid-lane compaction, no host syncs.
+        Gathers parents from the (compact) frontier, inflates their
+        message sets, materializes the children, deflates them back to
+        the compact form, and scans invariants — only per-slice scalars
+        ever reach the host.
+        """
+        K = self.K
+        pidx = (pay // K).astype(I32)
+        slots = pay % K
+        parents_c = jax.tree.map(lambda x: x[jnp.clip(pidx, 0, None)], frontier)
+        parents = self._inflate(parents_c)
+        children = self.kern.materialize(parents, slots)
+        child_f, ovf = self._deflate(children)
+        bad_at = self._inv_scan_impl(children, n_valid)
+        return child_f, bad_at, ovf
+
+    def _expand_chunk_impl(self, part_f: Frontier, start, n_f):
+        """One chunk: inflate + expand + mask + valid-lane compaction.
 
         start/n_f are device i64 scalars so chunk position doesn't force
         a recompile; the visited store is deliberately NOT an input (its
@@ -225,6 +344,8 @@ class JaxChecker:
         program).  Returns compacted candidates + chunk stats.
         """
         K = self.K
+        part = self._inflate(part_f)
+        msum_part = self.fpr.msg_hash(part.msgs)
         cap = part.voted_for.shape[0]
         exp = self.kern.expand(part, msum_part)
         in_range = (start + jnp.arange(cap, dtype=I64) < n_f)[:, None]
@@ -295,7 +416,7 @@ class JaxChecker:
 
     # -- checkpoint / resume (TLC's states/ metadir + -recover) ------------
 
-    def _save_checkpoint(self, path, frontier, msum, visited, n_f, distinct,
+    def _save_checkpoint(self, path, frontier, visited, n_f, distinct,
                          generated, depth, level_sizes, trace_levels,
                          mult_per_slot):
         arrs = {f"st_{k}": np.asarray(v) for k, v in frontier._asdict().items()}
@@ -305,7 +426,6 @@ class JaxChecker:
         tmp = f"{path}.tmp.npz"
         np.savez_compressed(
             tmp,
-            msum=np.asarray(msum),
             visited=np.asarray(visited),
             mult_per_slot=mult_per_slot,
             meta=np.asarray([n_f, distinct, generated, depth], np.int64),
@@ -318,7 +438,7 @@ class JaxChecker:
     @staticmethod
     def _load_checkpoint(path):
         z = np.load(path)
-        frontier = RaftState(
+        frontier = Frontier(
             **{k[3:]: jnp.asarray(z[k]) for k in z.files if k.startswith("st_")}
         )
         n_f, distinct, generated, depth = (int(x) for x in z["meta"])
@@ -327,7 +447,6 @@ class JaxChecker:
         ]
         return dict(
             frontier=frontier,
-            msum=jnp.asarray(z["msum"]),
             mult_per_slot=np.asarray(z["mult_per_slot"]),
             visited=jnp.asarray(z["visited"]),
             n_f=n_f,
@@ -340,24 +459,53 @@ class JaxChecker:
 
     # -- the main loop -----------------------------------------------------
 
-    def _expand_level(self, frontier, msum, n_f, visited):
-        """Expand all chunks; returns device arrays + one fused host fetch."""
-        cap_f = frontier.voted_for.shape[0]
+    def _expand_level(self, frontier: Frontier, n_f, visited):
+        """Expand all chunks; returns device arrays + one fused host fetch.
+
+        The frontier is device-resident in compact form; chunks are
+        carved out with dynamic slices (the frontier capacity is always a
+        multiple of the chunk size).
+        """
         n_f_dev = jnp.asarray(n_f, I64)
-        cvs, cfs, cps = [], [], []
+        cvs, cfs, cps = [], [], []  # pending (unfiltered) chunk outputs
+        gvs, gfs, gps = [], [], []  # filtered+compacted group outputs
         mult_acc = jnp.zeros((self.K,), I64)
         abort_at = BIG
         overflow = jnp.zeros((), bool)
-        for start in range(0, min(cap_f, _pow2(max(n_f, 1))), self.chunk):
-            part = jax.tree.map(
-                lambda x: jax.lax.dynamic_slice_in_dim(
-                    x, start, min(self.chunk, cap_f - start), 0
-                ),
+        overflow_g = jnp.zeros((), bool)
+        G = self.G  # chunks per visited-filter group
+        n_chunks = -(-max(n_f, 1) // self.chunk)
+        # group-filtering only pays (and only sizes correctly) once most
+        # candidates are revisits — at small frontiers the level-wide sort
+        # is tiny and new/parent ratios (up to ~2.5) would overflow cap_g.
+        # With a host store the device visited table is a dummy, so the
+        # filter could never drop anything.
+        grouping = n_chunks > 4 * G and self.host_store is None
+
+        def flush_group():
+            while len(cvs) < G:  # pad the group to its fixed width
+                cvs.append(jnp.full((self.cap_x,), SENT, U64))
+                cfs.append(jnp.full((self.cap_x,), SENT, U64))
+                cps.append(jnp.full((self.cap_x,), -1, I64))
+            gv, gf, gp, ovf = _group_filter(
+                jnp.concatenate(cvs), jnp.concatenate(cfs),
+                jnp.concatenate(cps), visited, self.cap_g,
+            )
+            gvs.append(gv)
+            gfs.append(gf)
+            gps.append(gp)
+            cvs.clear()
+            cfs.clear()
+            cps.clear()
+            return ovf
+
+        for start in range(0, max(n_f, 1), self.chunk):
+            part_f = jax.tree.map(
+                lambda x: jax.lax.dynamic_slice_in_dim(x, start, self.chunk),
                 frontier,
             )
             cv, cf, cp, mult_slots, ab_at, ovf = self._expand_chunk(
-                part,
-                msum[start : start + self.chunk],
+                part_f,
                 jnp.asarray(start, I64),
                 n_f_dev,
             )
@@ -367,23 +515,34 @@ class JaxChecker:
             mult_acc = mult_acc + mult_slots
             abort_at = jnp.minimum(abort_at, ab_at)
             overflow = overflow | ovf
+            if grouping and len(cvs) == G:
+                overflow_g = overflow_g | flush_group()
+        if grouping and cvs:
+            overflow_g = overflow_g | flush_group()
+        if grouping:
+            lvs, lfs, lps, width = gvs, gfs, gps, self.cap_g
+        else:
+            lvs, lfs, lps, width = cvs, cfs, cps, self.cap_x
         # pad the level-dedup input to a power-of-two lane count so its
         # sort program compiles O(log) times per run, not once per level
-        n_lanes = len(cvs) * self.cap_x
+        n_lanes = len(lvs) * width
         pad = _pow2(n_lanes) - n_lanes
         if pad:
-            cvs.append(jnp.full((pad,), SENT, U64))
-            cfs.append(jnp.full((pad,), SENT, U64))
-            cps.append(jnp.full((pad,), -1, I64))
+            lvs.append(jnp.full((pad,), SENT, U64))
+            lfs.append(jnp.full((pad,), SENT, U64))
+            lps.append(jnp.full((pad,), -1, I64))
         n_new_dev, new_fps, new_payload = _level_dedup(
-            jnp.concatenate(cvs), jnp.concatenate(cfs), jnp.concatenate(cps),
+            jnp.concatenate(lvs), jnp.concatenate(lfs), jnp.concatenate(lps),
             visited,
         )
         # ONE host sync for the level's control state
-        n_new, ab, ovf, mult_np = jax.device_get(
-            (n_new_dev, abort_at, overflow, mult_acc)
+        n_new, ab, ovf, ovf_g, mult_np = jax.device_get(
+            (n_new_dev, abort_at, overflow, overflow_g, mult_acc)
         )
-        return int(n_new), new_fps, new_payload, int(ab), bool(ovf), mult_np
+        return (
+            int(n_new), new_fps, new_payload, int(ab), bool(ovf), bool(ovf_g),
+            mult_np,
+        )
 
     def run(
         self,
@@ -405,16 +564,16 @@ class JaxChecker:
             )
         if resume_from is not None:
             ck = self._load_checkpoint(resume_from)
-            frontier, msum, visited = ck["frontier"], ck["msum"], ck["visited"]
+            frontier, visited = ck["frontier"], ck["visited"]
             n_f, distinct, generated = ck["n_f"], ck["distinct"], ck["generated"]
             depth, level_sizes, trace_levels = (
                 ck["depth"], ck["level_sizes"], ck["trace_levels"],
             )
             mult_per_slot = ck["mult_per_slot"]
         else:
-            frontier = init_batch(cfg, 1)
+            st0 = init_batch(cfg, 1)
             n_f = 1
-            fv, _ff, msum = self.fpr.state_fingerprints(frontier)
+            fv, _ff, _ms = self.fpr.state_fingerprints(st0)
             if self.host_store is not None:
                 self.host_store.insert(np.asarray(fv.astype(U64)))
                 visited = jnp.full((64,), SENT, U64)
@@ -429,9 +588,9 @@ class JaxChecker:
             trace_levels = []
             mult_per_slot = np.zeros(K, np.int64)
 
-            bad0 = int(np.asarray(self._inv_scan(frontier, jnp.asarray(1, I64))))
+            bad0 = int(np.asarray(self._inv_scan(st0, jnp.asarray(1, I64))))
             if bad0 >= 0:
-                name0 = self._bad_invariant_name(frontier, bad0)
+                name0 = self._bad_invariant_name(st0, bad0)
                 return CheckResult(
                     False, 1, 0, 0, (1,),
                     (
@@ -439,26 +598,37 @@ class JaxChecker:
                         self._trace(trace_levels, 0, 0),
                     ),
                 )
-        # pad the resumed/initial frontier to at least one chunk so the
-        # expand kernel compiles at the chunk shape only
-        if frontier.voted_for.shape[0] < self.chunk:
-            frontier = _pad_tree(frontier, self.chunk)
-            msum = _pad_axis0(msum, self.chunk)
+            frontier, ovf0 = jax.jit(self._deflate)(st0)
+            if bool(ovf0):
+                raise RuntimeError(
+                    f"initial state's message set exceeds cap_m={self.cap_m}"
+                )
+        # frontier capacity must be a chunk multiple for dynamic slicing
+        if frontier.voted_for.shape[0] % self.chunk:
+            cap0 = -(-frontier.voted_for.shape[0] // self.chunk) * self.chunk
+            frontier = jax.tree.map(
+                lambda x: _pad_axis0(x, cap0), frontier
+            )
 
         while n_f > 0:
             if max_depth is not None and depth >= max_depth:
                 break
             # --- expand + compact-then-dedup (device), fused level fetch -
             while True:
-                (n_new, new_fps, new_payload, abort_at, overflow, level_mult
-                 ) = self._expand_level(frontier, msum, n_f, visited)
-                if not overflow:
+                (n_new, new_fps, new_payload, abort_at, overflow, overflow_g,
+                 level_mult) = self._expand_level(frontier, n_f, visited)
+                if not (overflow or overflow_g):
                     break
-                # a chunk kept more survivors than its lane budget: grow
-                # and redo the level (pure computation, rare).  cap_x is
-                # baked into the traced program, so re-jit.
-                self.cap_x *= 2
-                self._expand_chunk = jax.jit(self._expand_chunk_impl)
+                # a lane budget overflowed: grow it and redo the level
+                # (pure computation, rare).  cap_x is baked into the traced
+                # chunk program, so re-jit; cap_g is a static jit arg and
+                # retraces on its own.
+                if overflow:
+                    self.cap_x *= 2
+                    self.cap_g = max(self.cap_g, self.G * self.cap_x // 2)
+                    self._expand_chunk = jax.jit(self._expand_chunk_impl)
+                if overflow_g:
+                    self.cap_g *= 2
             if abort_at < n_f:
                 # action_counts stays None on violations, like the oracle:
                 # coverage of a partially-expanded level is ill-defined
@@ -475,58 +645,72 @@ class JaxChecker:
             if self.host_store is not None and n_new:
                 fps_np = np.asarray(new_fps[:n_new])
                 is_new = self.host_store.insert(fps_np)
-                pay_np = np.asarray(new_payload[:n_new])[is_new]
-                n_new = len(pay_np)
-            else:
-                pay_np = np.asarray(new_payload[:n_new])
+                filtered = np.asarray(new_payload[:n_new])[is_new]
+                n_new = len(filtered)
+                new_payload = _pad_axis0(
+                    jnp.asarray(filtered), max(_pow2(n_new), 4 * self.chunk)
+                )
             if n_new == 0:
                 break
 
-            # --- materialize the survivors ------------------------------
-            # never shrink below one chunk: keeps the expand kernel at one
-            # compiled shape instead of one per pow2 frontier size.
-            # Materialization runs in chunk-sized slices: msg_hash unpacks
-            # a [n, n_words, 32] intermediate that would OOM at millions
-            # of survivors in one call.  pow2 (not pow4) capacity: at
-            # multi-million frontiers a 4x overshoot is gigabytes.
-            cap_c = max(_pow2(n_new), self.chunk)
-            pidx_np = pay_np // K
-            slot_np = pay_np % K
-            pidx = _pad_axis0(jnp.asarray(pidx_np, I64), cap_c)
-            slots = _pad_axis0(jnp.asarray(slot_np, I64), cap_c)
-            if cap_c <= 4 * self.chunk:
-                children, child_msum = self._gather_mat(frontier, pidx, slots)
-            else:
-                sl = 4 * self.chunk  # divides cap_c (both powers of two)
-                parts = [
-                    self._gather_mat(
-                        frontier, pidx[off : off + sl], slots[off : off + sl]
-                    )
-                    for off in range(0, cap_c, sl)
-                ]
-                children = jax.tree.map(
-                    lambda *xs: jnp.concatenate(xs), *(p[0] for p in parts)
+            # --- materialize the survivors (device-resident) ------------
+            # slice width must not exceed the payload capacity (a custom
+            # cap_x < 4*chunk shrinks the dedup output below 4*chunk)
+            sl = min(4 * self.chunk, new_payload.shape[0])
+            child_parts, bad_ds, ovf_ds = [], [], []
+            n_slices = -(-n_new // sl)
+            for si in range(n_slices):
+                off = si * sl
+                take = min(sl, n_new - off)
+                pay_slice = jax.lax.dynamic_slice_in_dim(new_payload, off, sl)
+                ch_f, bad_d, ovf_d = self._mat_slice(
+                    frontier, pay_slice, jnp.asarray(take, I64)
                 )
-                child_msum = jnp.concatenate([p[1] for p in parts])
+                child_parts.append(ch_f)
+                bad_ds.append(bad_d)
+                ovf_ds.append(ovf_d)
+            # one fused fetch of the per-slice scalars + the trace spill
+            pidx32 = (new_payload[: n_slices * sl] // K).astype(U32C)
+            slot16 = (new_payload[: n_slices * sl] % K).astype(jnp.uint16)
+            bads, ovfs, pidx_np, slot_np = jax.device_get(
+                (bad_ds, ovf_ds, pidx32, slot16)
+            )
+            pidx_np = pidx_np[:n_new].astype(np.int64)
+            slot_np = slot_np[:n_new].astype(np.int64)
+            if any(ovfs):
+                raise RuntimeError(
+                    f"message-set width exceeded cap_m={self.cap_m} at "
+                    f"level {depth + 1}; rerun with a larger cap_m"
+                )
+            bad_idx = -1
+            for si, b in enumerate(bads):
+                if b >= 0:
+                    bad_idx = si * sl + int(b)
+                    bad_slice, bad_local = child_parts[si], int(b)
+                    break
+            # pow2-quantized capacity: _mat_slice and the expand slicing
+            # take the frontier as a traced input, so its shape must cycle
+            # through O(log) values per run, not one per level
+            cap_f = max(_pow2(n_new), self.chunk)
+            frontier = jax.tree.map(
+                lambda *xs: _pad_axis0(jnp.concatenate(xs), cap_f),
+                *child_parts,
+            )
 
-            # --- bookkeeping, invariants, store merge -------------------
-            trace_levels.append((pidx_np.astype(np.int64), slot_np.astype(np.int64)))
+            # --- bookkeeping, store merge -------------------------------
+            trace_levels.append((pidx_np, slot_np))
             distinct += n_new
             level_sizes.append(n_new)
             depth += 1
 
-            bad_idx = int(
-                np.asarray(self._inv_scan(children, jnp.asarray(n_new, I64)))
-            )
-
             if self.host_store is None:
                 # merge, then trim the store to a pow4 capacity >= distinct;
-                # new_fps is survivor-compacted, so slicing to cap_c keeps
-                # every real fingerprint and bounds the sort input
-                visited = _merge_sorted(visited, new_fps[:cap_c])[
-                    : _cap4(distinct + 1)
-                ]
-            frontier, msum, n_f = children, child_msum, n_new
+                # new_fps is survivor-compacted, so slicing keeps every
+                # real fingerprint and bounds the sort input
+                visited = _merge_sorted(
+                    visited, new_fps[: max(_pow2(n_new), self.chunk)]
+                )[: _cap4(distinct + 1)]
+            n_f = n_new
 
             if self.progress is not None:
                 self.progress(
@@ -539,7 +723,12 @@ class JaxChecker:
                     )
                 )
             if bad_idx >= 0:
-                name = self._bad_invariant_name(children, bad_idx)
+                one = self._inflate(
+                    jax.tree.map(
+                        lambda x: x[bad_local : bad_local + 1], bad_slice
+                    )
+                )
+                name = self._bad_invariant_name(one, 0)
                 return CheckResult(
                     False, distinct, generated, depth, tuple(level_sizes),
                     (
@@ -553,7 +742,7 @@ class JaxChecker:
             if checkpoint_dir and checkpoint_every and depth % checkpoint_every == 0:
                 os.makedirs(checkpoint_dir, exist_ok=True)
                 self._save_checkpoint(
-                    os.path.join(checkpoint_dir, "latest.npz"), frontier, msum,
+                    os.path.join(checkpoint_dir, "latest.npz"), frontier,
                     visited, n_f, distinct, generated, depth, level_sizes,
                     trace_levels, mult_per_slot,
                 )
