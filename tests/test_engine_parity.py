@@ -25,12 +25,13 @@ PARITY_CFGS = [
 ]
 
 
+@pytest.mark.parametrize("canon", ["late", "expand"])
 @pytest.mark.parametrize(
     "cfg", PARITY_CFGS, ids=[f"s{c.S}e{c.max_election}{'sym' if c.symmetry else 'full'}{'' if c.use_view else 'noview'}" for c in PARITY_CFGS]
 )
-def test_full_run_parity(cfg):
+def test_full_run_parity(cfg, canon):
     want = OracleChecker(cfg).run()
-    got = JaxChecker(cfg, chunk=64).run()
+    got = JaxChecker(cfg, chunk=64, canon=canon).run()
     assert got.ok == want.ok
     assert got.distinct == want.distinct
     assert got.generated == want.generated

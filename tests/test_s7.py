@@ -1,0 +1,131 @@
+"""Scale config: 7-server model (BASELINE.md config 5).
+
+S=7 has a 5040-element symmetry group, which is where the round-2
+formulation hits its walls (SURVEY.md §7.4): the permutation-folded
+message table would be 2.7 GB and folding the hash into every fan-out
+lane would need [B, K=3696, P=5040] intermediates.  These tests prove
+the two counter-designs actually work end to end:
+
+* the **pair-block factored** message-set hash (ops/fingerprint.py
+  ``_msg_hash_factored`` — bit-identical to the monolithic matmul,
+  asserted at S=3/5 where both exist; auto-selected at S=7),
+* the **late-canonicalization** engine path (guards-only expand; only
+  compacted candidates are materialized and P-folded).
+"""
+
+import collections
+import dataclasses
+
+import numpy as np
+import pytest
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.models.raft import from_oracle
+from tla_raft_tpu.ops.fingerprint import Fingerprinter
+from tla_raft_tpu.ops.successor import get_kernel
+from tla_raft_tpu.oracle import OracleChecker
+from tla_raft_tpu.oracle.explicit import init_state, successors
+
+
+@pytest.fixture(scope="module")
+def cfg7():
+    # bounded 7-server space: the oracle pays 5040 permutations per
+    # canonical key in pure Python, so keep the test space tiny
+    cfg = load_raft_config("/root/reference/Raft.cfg")
+    return dataclasses.replace(
+        cfg, n_servers=7, n_vals=1, max_election=1, max_restart=0
+    )
+
+
+def collect(cfg, n):
+    seen, order, frontier = {init_state(cfg)}, [init_state(cfg)], [init_state(cfg)]
+    while frontier and len(order) < n:
+        nxt = []
+        for st in frontier:
+            for _a, _s, _d, ch in successors(cfg, st):
+                if ch not in seen:
+                    seen.add(ch)
+                    order.append(ch)
+                    nxt.append(ch)
+        frontier = nxt
+    return order[:n]
+
+
+def test_universe_dimensions_and_factored_selection(cfg7):
+    kern = get_kernel(cfg7)
+    assert kern.fpr.P == 5040
+    assert kern.uni.M == 966  # S=7, T=1, V=1 bounds (42 pairs x 23 ids)
+    assert kern.fpr.factored_msgs  # pair-block tables auto-selected
+    # full-bounds S=7 universe (T=3, V=2): the SCALING.md numbers
+    full = RaftConfig(n_servers=7, n_vals=2, max_election=3, max_restart=3)
+    from tla_raft_tpu.ops.msg_universe import get_universe
+
+    assert get_universe(full).M == 33768
+
+
+@pytest.mark.parametrize("n_servers", [3, 5])
+def test_factored_hash_bit_identical(n_servers):
+    """Where both representations fit, they must agree bit for bit."""
+    cfg = RaftConfig(
+        n_servers=n_servers, n_vals=2, max_election=3, max_restart=3
+    )
+    import jax.numpy as jnp
+
+    mono = Fingerprinter(cfg, force_factored=False)
+    fact = Fingerprinter(cfg, force_factored=True)
+    rng = np.random.default_rng(7)
+    packed = rng.integers(
+        0, 1 << 32, size=(13, mono.uni.n_words), dtype=np.uint32
+    )
+    tail = mono.uni.n_words * 32 - mono.uni.M
+    if tail:
+        packed[:, -1] &= np.uint32((1 << (32 - tail)) - 1)
+    a = np.asarray(mono.msg_hash(jnp.asarray(packed)))
+    b = np.asarray(fact.msg_hash(jnp.asarray(packed)))
+    assert np.array_equal(a, b)
+
+
+def test_guards_and_children_match_oracle_s7(cfg7):
+    """Sampled differential: guards-only expand + materialized-child
+    fingerprints against the oracle's successor sets."""
+    import jax.numpy as jnp
+
+    kern = get_kernel(cfg7)
+    fpr = kern.fpr
+    states = collect(cfg7, 12)
+    batch = from_oracle(cfg7, states)
+    valid, mult, abort = kern.expand_guards(batch)
+    valid, mult = np.asarray(valid), np.asarray(mult)
+    assert not np.asarray(abort).any()
+
+    all_succs = [successors(cfg7, st) for st in states]
+    flat = [ch for ss in all_succs for _a, _s, _d, ch in ss]
+    ev, _, _ = fpr.state_fingerprints(from_oracle(cfg7, flat))
+    ev = np.asarray(ev)
+    # materialize every valid slot and fingerprint the children (the
+    # late-canonicalization pipeline), one parent at a time
+    off = 0
+    for i, succs in enumerate(all_succs):
+        assert int(mult[i][valid[i]].sum()) == len(succs), f"state {i}"
+        want = collections.Counter(ev[off : off + len(succs)].tolist())
+        off += len(succs)
+        slots = np.nonzero(valid[i])[0]
+        parents = from_oracle(cfg7, [states[i]] * len(slots))
+        children = kern.materialize(parents, jnp.asarray(slots))
+        cv, _, _ = fpr.state_fingerprints(children)
+        got = collections.Counter()
+        for j, k in enumerate(slots):
+            got[int(np.asarray(cv)[j])] += int(mult[i, k])
+        assert got == want, f"state {i}"
+
+
+def test_engine_parity_s7(cfg7):
+    """Full BFS parity engine-vs-oracle on the bounded 7-server space."""
+    o = OracleChecker(cfg7).run(max_depth=4)
+    e = JaxChecker(cfg7, chunk=64).run(max_depth=4)
+    assert o.ok and e.ok
+    assert e.level_sizes == o.level_sizes
+    assert e.generated == o.generated
+    assert e.distinct == o.distinct

@@ -20,15 +20,18 @@ CFGS = [
 ]
 
 
+@pytest.mark.parametrize("canon", ["late", "expand"])
 @pytest.mark.parametrize("exchange", ["all_to_all", "all_gather"])
 @pytest.mark.parametrize("ndev", [2, 8])
 @pytest.mark.parametrize("cfg", CFGS, ids=["s2", "s3"])
-def test_sharded_parity(cfg, ndev, exchange):
+def test_sharded_parity(cfg, ndev, exchange, canon):
     if len(jax.devices()) < ndev:
         pytest.skip("not enough virtual devices")
     want = OracleChecker(cfg).run()
     mesh = make_mesh(ndev)
-    got = ShardedChecker(cfg, mesh, cap_x=512, vcap=4096, exchange=exchange).run()
+    got = ShardedChecker(
+        cfg, mesh, cap_x=512, vcap=4096, exchange=exchange, canon=canon
+    ).run()
     assert got.ok == want.ok
     assert got.distinct == want.distinct
     assert got.generated == want.generated

@@ -139,6 +139,11 @@ def main(argv=None) -> int:
                    default="all_to_all", help="distributed fingerprint exchange")
     p.add_argument("--cap-x", type=int, default=4096,
                    help="per-device candidate capacity (distributed mode)")
+    p.add_argument("--canon", choices=("late", "expand"), default="late",
+                   help="candidate canonicalization: 'late' fingerprints "
+                        "only compacted candidates (default; required for "
+                        "big symmetry groups), 'expand' folds the hash "
+                        "into every fan-out lane")
     p.add_argument("--log", default="raft.log")
     p.add_argument("--coverage", action="store_true",
                    help="print per-action fired-transition counts (TLC -coverage)")
@@ -240,7 +245,7 @@ def main(argv=None) -> int:
 
             res = ShardedChecker(
                 cfg, make_mesh(args.mesh), cap_x=args.cap_x,
-                exchange=args.exchange, progress=progress,
+                exchange=args.exchange, progress=progress, canon=args.canon,
             ).run(
                 max_depth=args.max_depth,
                 checkpoint_dir=args.checkpoint_dir,
@@ -250,7 +255,7 @@ def main(argv=None) -> int:
         else:
             res = JaxChecker(
                 cfg, chunk=args.chunk, progress=progress,
-                host_store=host_store,
+                host_store=host_store, canon=args.canon,
             ).run(
                 max_depth=args.max_depth,
                 checkpoint_dir=args.checkpoint_dir,

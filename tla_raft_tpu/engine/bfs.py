@@ -135,6 +135,32 @@ def _pad_axis0(x: jnp.ndarray, cap: int) -> jnp.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=("cap_x",))
+def _compact_payloads(valid_flat, payload, cap_x: int):
+    """Compact the valid fan-out lanes' payloads into cap_x lanes.
+
+    The late-canonicalization variant of ``_chunk_compact``: keys on the
+    validity mask alone (fingerprints don't exist yet at this point — they
+    are computed afterwards from the materialized candidates).  Kept lanes
+    preserve original lane order (payload-ascending).  Shared by the
+    single-device and mesh engines; outputs are cap_x wide even when the
+    fan-out is smaller (tiny mesh frontiers have C = cap_f*K < cap_x).
+    Returns (payload[cap_x] with garbage beyond ``lane``, lane bool[cap_x],
+    overflow).
+    """
+    C = valid_flat.shape[0]
+    n_live = valid_flat.sum()
+    k = min(cap_x, C)
+    key = jnp.where(valid_flat, C - jnp.arange(C, dtype=I32), 0)
+    vals, idx = jax.lax.top_k(key, k)
+    lane = vals > 0
+    cp = payload[idx]
+    if cap_x > k:
+        lane = jnp.concatenate([lane, jnp.zeros((cap_x - k,), bool)])
+        cp = jnp.concatenate([cp, jnp.full((cap_x - k,), -1, cp.dtype)])
+    return cp, lane, n_live > cap_x
+
+
+@functools.partial(jax.jit, static_argnames=("cap_x",))
 def _chunk_compact(fps_view, fps_full, payload, cap_x: int):
     """Compact one chunk's valid fan-out lanes into cap_x lanes (no dedup).
 
@@ -242,7 +268,17 @@ class JaxChecker:
         progress: Callable[[dict], None] | None = None,
         host_store=None,
         cap_m: int = 96,
+        canon: str = "late",
     ):
+        # canon="late": expand computes guards only; the compacted
+        # candidates are materialized and fingerprinted with the full-state
+        # path — the P-wide symmetry fold runs over ~3.5 candidates/state
+        # instead of all K fan-out lanes (the enabler for big symmetry
+        # groups, and faster even at S=3).  canon="expand": fold the
+        # symmetry hash into every fan-out lane (the round-2 formulation,
+        # kept as a differential reference).
+        assert canon in ("late", "expand")
+        self.canon = canon
         self.cfg = cfg
         self.kern: SuccessorKernel = get_kernel(cfg)
         self.fpr = self.kern.fpr
@@ -296,13 +332,16 @@ class JaxChecker:
 
     def _msgs_to_ids(self, msgs: jnp.ndarray):
         """packed u32 [n, n_words] -> (ids [n, cap_m] ascending -1-padded,
-        overflow bool): top_k over bit-position keys."""
+        overflow bool[n]): top_k over bit-position keys.  Overflow is
+        per-row so callers can mask out garbage/padding lanes (a padded
+        materialize lane holds a clipped parent's garbage child, which
+        must not abort a real run)."""
         M = self.kern.uni.M
         bits = self.fpr.unpack_bits(msgs).astype(I32)
         key = bits * (M - jnp.arange(M, dtype=I32))
         vals, _ = jax.lax.top_k(key, self.cap_m)
         ids = jnp.where(vals > 0, M - vals, -1)
-        ovf = bits.sum(-1, dtype=I32).max() > self.cap_m
+        ovf = bits.sum(-1, dtype=I32) > self.cap_m
         return ids.astype(self.id_dtype), ovf
 
     def _inflate(self, fr: Frontier) -> RaftState:
@@ -331,9 +370,10 @@ class JaxChecker:
         parents_c = jax.tree.map(lambda x: x[jnp.clip(pidx, 0, None)], frontier)
         parents = self._inflate(parents_c)
         children = self.kern.materialize(parents, slots)
-        child_f, ovf = self._deflate(children)
+        child_f, ovf_rows = self._deflate(children)
+        in_range = jnp.arange(ovf_rows.shape[0], dtype=I64) < n_valid
         bad_at = self._inv_scan_impl(children, n_valid)
-        return child_f, bad_at, ovf
+        return child_f, bad_at, (ovf_rows & in_range).any()
 
     def _expand_chunk_impl(self, part_f: Frontier, start, n_f):
         """One chunk: inflate + expand + mask + valid-lane compaction.
@@ -342,24 +382,46 @@ class JaxChecker:
         a recompile; the visited store is deliberately NOT an input (its
         capacity grows over the run and would retrace this — the largest —
         program).  Returns compacted candidates + chunk stats.
+
+        canon="late": the expand is guards-only; the compacted candidate
+        (parent, slot) pairs are materialized in-chunk and fingerprinted
+        from their full states (feat matmul + message-set matmul, both
+        P-folded) — the symmetry fold touches cap_x lanes, not cap*K.
         """
         K = self.K
         part = self._inflate(part_f)
-        msum_part = self.fpr.msg_hash(part.msgs)
         cap = part.voted_for.shape[0]
-        exp = self.kern.expand(part, msum_part)
+        if self.canon == "late":
+            valid, mult, ab_state = self.kern.expand_guards(part)
+        else:
+            msum_part = self.fpr.msg_hash(part.msgs)
+            exp = self.kern.expand(part, msum_part)
+            valid, mult, ab_state = exp.valid, exp.mult, exp.abort
         in_range = (start + jnp.arange(cap, dtype=I64) < n_f)[:, None]
-        valid = exp.valid & in_range
-        fpv = jnp.where(valid, exp.fp_view, SENT).ravel()
-        fpf = jnp.where(valid, exp.fp_full, SENT).ravel()
+        valid = valid & in_range
         base = ((start + jnp.arange(cap, dtype=I64)) * K)[:, None]
         payload = (base + jnp.arange(K, dtype=I64)[None]).ravel()
-        mult_slots = jnp.where(valid, exp.mult, 0).astype(I64).sum(0)
-        ab = exp.abort & in_range[:, 0]
+        mult_slots = jnp.where(valid, mult, 0).astype(I64).sum(0)
+        ab = ab_state & in_range[:, 0]
         abort_at = jnp.where(
             ab.any(), start + jnp.argmax(ab).astype(I64), BIG
         )
-        cv, cf, cp, overflow = _chunk_compact(fpv, fpf, payload, self.cap_x)
+        if self.canon == "late":
+            cp_raw, lane, overflow = _compact_payloads(
+                valid.ravel(), payload, self.cap_x
+            )
+            lidx = jnp.clip(cp_raw // K - start, 0, cap - 1).astype(I32)
+            slots = cp_raw % K
+            parents = jax.tree.map(lambda x: x[lidx], part)
+            children = self.kern.materialize(parents, slots)
+            fv, ff, _msum = self.fpr.state_fingerprints(children)
+            cv = jnp.where(lane, fv.astype(U64), SENT)
+            cf = jnp.where(lane, ff.astype(U64), SENT)
+            cp = jnp.where(lane, cp_raw, -1)
+        else:
+            fpv = jnp.where(valid, exp.fp_view, SENT).ravel()
+            fpf = jnp.where(valid, exp.fp_full, SENT).ravel()
+            cv, cf, cp, overflow = _chunk_compact(fpv, fpf, payload, self.cap_x)
         return cv, cf, cp, mult_slots, abort_at, overflow
 
     def _inv_scan_impl(self, children: RaftState, n_valid):
@@ -438,6 +500,13 @@ class JaxChecker:
     @staticmethod
     def _load_checkpoint(path):
         z = np.load(path)
+        fields = {k[3:] for k in z.files if k.startswith("st_")}
+        if fields != set(Frontier._fields):
+            raise ValueError(
+                f"incompatible checkpoint format at {path}: has fields "
+                f"{sorted(fields)}, this build expects "
+                f"{sorted(Frontier._fields)} (written by an older engine?)"
+            )
         frontier = Frontier(
             **{k[3:]: jnp.asarray(z[k]) for k in z.files if k.startswith("st_")}
         )
@@ -599,7 +668,7 @@ class JaxChecker:
                     ),
                 )
             frontier, ovf0 = jax.jit(self._deflate)(st0)
-            if bool(ovf0):
+            if bool(ovf0.any()):
                 raise RuntimeError(
                     f"initial state's message set exceeds cap_m={self.cap_m}"
                 )

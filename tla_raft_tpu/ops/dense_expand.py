@@ -181,7 +181,13 @@ class DenseExpand:
 
     # ---- the expand ------------------------------------------------------
 
-    def __call__(self, st, msum):
+    def __call__(self, st, msum, want_fp: bool = True):
+        """Dense pass 1.  ``want_fp=False`` computes guards only (valid,
+        mult, abort; fp outputs are None) — the late-canonicalization
+        engine path fingerprints the few compacted *candidates* from their
+        materialized states instead of folding the P-wide symmetry hash
+        into every one of the B*K fan-out lanes, which is what makes
+        large symmetry groups (S=5: P=120, S=7: P=5040) affordable."""
         cfg, uni = self.cfg, self.uni
         S, T, L, V, E, NP = self.S, self.T, self.L, self.V, self.E, self.NP
         P, NC = self.fpr.P, self.fpr.N_CHAN
@@ -223,21 +229,24 @@ class DenseExpand:
         has_term = ct >= 1
         oh_ll_pos = _oh(jnp.clip(ll - 1, 0, L - 1), L)  # mylli digit (ll-1)
         llt_val = (oh_ll_pos * lt).sum(-1, dtype=I32)  # lt[b, s, ll-1]
-        oh_vfw = _oh(vf, S + 1).astype(U32)
-        old_vf_c = jnp.einsum("bsw,swpc->bspc", oh_vfw, self.C_vf)
         not_self = ~jnp.eye(S, dtype=bool)[None]
         tcur1 = jnp.clip(ct, 1, T)  # term clamped to >= 1 for encoders
 
-        base = self.fpr.feat_hash(self.fpr.spec.features(st)) + msum  # [B,P,C]
+        if want_fp:
+            oh_vfw = _oh(vf, S + 1).astype(U32)
+            old_vf_c = jnp.einsum("bsw,swpc->bspc", oh_vfw, self.C_vf)
+            base = self.fpr.feat_hash(self.fpr.spec.features(st)) + msum  # [B,P,C]
 
         fpv_parts, fpf_parts, valid_parts, mult_parts = [], [], [], []
 
-        def emit(valid, mult, dh):
+        def emit(valid, mult, dh=None):
             """valid bool[B,*W], mult i32[B,*W], dh u32[B,*W,P,chan]."""
-            h = base.reshape(B, *([1] * (dh.ndim - 3)), P, NC) + dh
-            v, f = self.fpr.finalize(h)
             valid_parts.append(valid.reshape(B, -1))
             mult_parts.append(mult.reshape(B, -1))
+            if dh is None:
+                return
+            h = base.reshape(B, *([1] * (dh.ndim - 3)), P, NC) + dh
+            v, f = self.fpr.finalize(h)
             fpv_parts.append(v.reshape(B, -1))
             fpf_parts.append(f.reshape(B, -1))
 
@@ -246,44 +255,48 @@ class DenseExpand:
             return C * delta.astype(U32)[..., None, None]
 
         # ---- F0 BecomeCandidate(s)  axes [B, s] --------------------------
-        new_term = jnp.clip(ct + 1, 1, T)
-        llt_cand = jnp.clip(llt_val, 0, T - 1)  # lastLogTerm < minted term
         valid0 = (ec[:, None] < cfg.max_election) & (
             (role == FOLLOWER) | (role == CANDIDATE)
         )
-        dh0 = (
-            dmul(self.C_ct, new_term - ct)
-            + dmul(self.C_role, CANDIDATE - role)
-            + self.C_vf_self
-            - old_vf_c
-            + self.C_ec
-        )
-        if S > 1:
-            oh_t0 = _oh(new_term - 1, T)
-            oh_lli0 = oh_ll_pos
-            oh_llt0 = _oh(llt_cand, T)
-            present0 = jnp.einsum(
-                "bptlk,srp,bst,bsl,bsk->bsr",
-                vq, self.SELPEER, oh_t0, oh_lli0, oh_llt0,
-            )  # [B, s, peer]
-            rest0 = ((new_term - 1) * L + (ll - 1)) * T + llt_cand  # [B, s]
-            dmsg0 = self._add_msg(
-                self._pair_peers, 0,
-                jnp.broadcast_to(rest0[:, :, None], (B, S, S - 1)),
-                1 - present0,
-            ).sum(2, dtype=U32)
-            dh0 = dh0 + dmsg0
+        dh0 = None
+        if want_fp:
+            new_term = jnp.clip(ct + 1, 1, T)
+            llt_cand = jnp.clip(llt_val, 0, T - 1)  # lastLogTerm < minted term
+            dh0 = (
+                dmul(self.C_ct, new_term - ct)
+                + dmul(self.C_role, CANDIDATE - role)
+                + self.C_vf_self
+                - old_vf_c
+                + self.C_ec
+            )
+            if S > 1:
+                oh_t0 = _oh(new_term - 1, T)
+                oh_lli0 = oh_ll_pos
+                oh_llt0 = _oh(llt_cand, T)
+                present0 = jnp.einsum(
+                    "bptlk,srp,bst,bsl,bsk->bsr",
+                    vq, self.SELPEER, oh_t0, oh_lli0, oh_llt0,
+                )  # [B, s, peer]
+                rest0 = ((new_term - 1) * L + (ll - 1)) * T + llt_cand  # [B, s]
+                dmsg0 = self._add_msg(
+                    self._pair_peers, 0,
+                    jnp.broadcast_to(rest0[:, :, None], (B, S, S - 1)),
+                    1 - present0,
+                ).sum(2, dtype=U32)
+                dh0 = dh0 + dmsg0
         emit(valid0, jnp.ones((B, S), I32), dh0)
 
         # ---- F1 UpdateTerm branch (a)  axes [B, s, t0] -------------------
         t_ax = jnp.arange(1, T + 1, dtype=I32)
         valid1 = (t_ax[None, None, :] > ct[:, :, None]) & (to_cnt > 0)
-        dh1 = (
-            dmul(self.C_ct[:, None], t_ax[None, None, :] - ct[:, :, None])
-            + (dmul(self.C_role, FOLLOWER - role) + self.C_vf[:, 0] - old_vf_c)[
-                :, :, None
-            ]
-        )
+        dh1 = None
+        if want_fp:
+            dh1 = (
+                dmul(self.C_ct[:, None], t_ax[None, None, :] - ct[:, :, None])
+                + (dmul(self.C_role, FOLLOWER - role) + self.C_vf[:, 0] - old_vf_c)[
+                    :, :, None
+                ]
+            )
         emit(valid1, to_cnt, dh1)
 
         # ---- F2 UpdateTerm branch (b) + Assert  axes [B, s] --------------
@@ -291,7 +304,7 @@ class DenseExpand:
         has2 = has_term & (cnt2 > 0)
         valid2 = has2 & (role == CANDIDATE)
         abort = (has2 & (role == LEADER)).any(1)
-        dh2 = dmul(self.C_role, FOLLOWER - role)
+        dh2 = dmul(self.C_role, FOLLOWER - role) if want_fp else None
         emit(valid2, cnt2, dh2)
 
         # ---- F3 ResponseVote(s, cand)  axes [B, s, c] --------------------
@@ -317,26 +330,33 @@ class DenseExpand:
             & (grant_bit == 0)
         )
         # votedFor[s]: old -> cand+1
-        dh3 = self.C_vf[None, :, 1:] - old_vf_c[:, :, None]
-        rest3 = jnp.broadcast_to((tcur1 - 1)[:, :, None], (B, S, S))
-        dmsg3 = self._add_msg(self._pair_ab, 1, rest3, 1 - grant_bit)
-        emit(valid3, qual_cnt, dh3 + dmsg3)
+        dh3 = None
+        if want_fp:
+            dh3 = self.C_vf[None, :, 1:] - old_vf_c[:, :, None]
+            rest3 = jnp.broadcast_to((tcur1 - 1)[:, :, None], (B, S, S))
+            dmsg3 = self._add_msg(self._pair_ab, 1, rest3, 1 - grant_bit)
+            dh3 = dh3 + dmsg3
+        emit(valid3, qual_cnt, dh3)
 
         # ---- F4 BecomeLeader(s)  axes [B, s] -----------------------------
         votes = jnp.einsum("bpt,sp,bst->bs", vp, self.SELD, oh_ct)
         valid4 = (role == CANDIDATE) & (votes + 1 >= cfg.majority)
-        ar = jnp.arange(S, dtype=I32)
-        mi_tgt = jnp.where(ar[None, None, :] == ar[None, :, None], ll[:, :, None], 1)
-        dh4 = (
-            dmul(self.C_role, LEADER - role)
-            + jnp.einsum(
-                "bsu,supc->bspc", (mi_tgt - mi).astype(U32), self.C_mi
+        dh4 = None
+        if want_fp:
+            ar = jnp.arange(S, dtype=I32)
+            mi_tgt = jnp.where(
+                ar[None, None, :] == ar[None, :, None], ll[:, :, None], 1
             )
-            + jnp.einsum(
-                "bsu,supc->bspc", ((ll[:, :, None] + 1) - ni).astype(U32), self.C_ni
+            dh4 = (
+                dmul(self.C_role, LEADER - role)
+                + jnp.einsum(
+                    "bsu,supc->bspc", (mi_tgt - mi).astype(U32), self.C_mi
+                )
+                + jnp.einsum(
+                    "bsu,supc->bspc", ((ll[:, :, None] + 1) - ni).astype(U32), self.C_ni
+                )
+                + jnp.einsum("bsu,supc->bspc", (-pend).astype(U32), self.C_pend)
             )
-            + jnp.einsum("bsu,supc->bspc", (-pend).astype(U32), self.C_pend)
-        )
         emit(valid4, jnp.ones((B, S), I32), dh4)
 
         # ---- F5 ClientReq(s, v)  axes [B, s, v] --------------------------
@@ -345,20 +365,22 @@ class DenseExpand:
             & (vs[:, None, :] == 0)
             & (ll < L)[:, :, None]
         )
-        pos_oh = _oh(jnp.clip(ll, 0, L - 1), L)  # append slot (0-based = ll)
-        d_lt5 = jnp.einsum(
-            "bsl,slpc->bspc",
-            (pos_oh * (ct[:, :, None] - lt)).astype(U32), self.C_lt,
-        )
-        C_lv_pos = jnp.einsum("bsl,slpc->bspc", pos_oh.astype(U32), self.C_lv)
-        lv_pos = (pos_oh * lv).sum(-1, dtype=I32)  # [B, s]
-        v_val = jnp.arange(1, V + 1, dtype=I32)
-        d_lv5 = C_lv_pos[:, :, None] * (
-            (v_val[None, None, :] - lv_pos[:, :, None]).astype(U32)[..., None, None]
-        )
-        d_mid5 = dmul(self.C_mi_diag, (ll + 1) - jnp.einsum("bss->bs", mi))
-        d_vs5 = dmul(self.C_vs, 1 - vs)  # [B, v, P, C]
-        dh5 = (d_lt5 + self.C_ll + d_mid5)[:, :, None] + d_lv5 + d_vs5[:, None]
+        dh5 = None
+        if want_fp:
+            pos_oh = _oh(jnp.clip(ll, 0, L - 1), L)  # append slot (0-based = ll)
+            d_lt5 = jnp.einsum(
+                "bsl,slpc->bspc",
+                (pos_oh * (ct[:, :, None] - lt)).astype(U32), self.C_lt,
+            )
+            C_lv_pos = jnp.einsum("bsl,slpc->bspc", pos_oh.astype(U32), self.C_lv)
+            lv_pos = (pos_oh * lv).sum(-1, dtype=I32)  # [B, s]
+            v_val = jnp.arange(1, V + 1, dtype=I32)
+            d_lv5 = C_lv_pos[:, :, None] * (
+                (v_val[None, None, :] - lv_pos[:, :, None]).astype(U32)[..., None, None]
+            )
+            d_mid5 = dmul(self.C_mi_diag, (ll + 1) - jnp.einsum("bss->bs", mi))
+            d_vs5 = dmul(self.C_vs, 1 - vs)  # [B, v, P, C]
+            dh5 = (d_lt5 + self.C_ll + d_mid5)[:, :, None] + d_lv5 + d_vs5[:, None]
         emit(valid5, jnp.ones((B, S, V), I32), dh5)
 
         # ---- F6 LeaderAppendEntry(s, d)  axes [B, s, d] ------------------
@@ -385,13 +407,18 @@ class DenseExpand:
             & (pend == 0)
             & (present6 == 0)
         )
-        dh6 = jnp.einsum("bsd,sdpc->bsdpc", (1 - pend).astype(U32), self.C_pend)
-        rest6 = (
-            (((tcur1[:, :, None] - 1) * L + (pli6 - 1)) * (T + 1) + plt6) * E
-            + ecode6
-        ) * L + (lc6 - 1)
-        dmsg6 = self._add_msg(self._pair_ab, 2, rest6, 1 - present6)
-        emit(valid6, jnp.ones((B, S, S), I32), dh6 + dmsg6)
+        dh6 = None
+        if want_fp:
+            dh6 = jnp.einsum(
+                "bsd,sdpc->bsdpc", (1 - pend).astype(U32), self.C_pend
+            )
+            rest6 = (
+                (((tcur1[:, :, None] - 1) * L + (pli6 - 1)) * (T + 1) + plt6) * E
+                + ecode6
+            ) * L + (lc6 - 1)
+            dmsg6 = self._add_msg(self._pair_ab, 2, rest6, 1 - present6)
+            dh6 = dh6 + dmsg6
+        emit(valid6, jnp.ones((B, S, S), I32), dh6)
 
         # ---- F7 FollowerAcceptEntry(s, src, pli, e, lc)  -----------------
         # axes [B, s, c(src), l(pli0), e, h(lc0)]
@@ -409,61 +436,62 @@ class DenseExpand:
             & log_match[:, :, None, :, None, None]
             & (present7 > 0)
         )
-        # log rewrite deltas (only when `updated`)
-        append_new = self.NL[None, None] > ll[:, :, None, None]  # [B, s, l, e]
-        lt_next = jnp.concatenate([lt[..., 1:], lt[..., -1:]], axis=-1)
-        lv_next = jnp.concatenate([lv[..., 1:], lv[..., -1:]], axis=-1)
-        conflict = (
-            (self.EL[None, None, None] == 1)
-            & (pli_ax[None, None, :, None] < ll[:, :, None, None])
-            & (
-                (lt_next[:, :, :, None] != self.ETERM[None, None, None])
-                | (lv_next[:, :, :, None] != self.EVAL[None, None, None])
+        dh7 = None
+        if want_fp:
+            # log rewrite deltas (only when `updated`)
+            append_new = self.NL[None, None] > ll[:, :, None, None]  # [B, s, l, e]
+            lt_next = jnp.concatenate([lt[..., 1:], lt[..., -1:]], axis=-1)
+            lv_next = jnp.concatenate([lv[..., 1:], lv[..., -1:]], axis=-1)
+            conflict = (
+                (self.EL[None, None, None] == 1)
+                & (pli_ax[None, None, :, None] < ll[:, :, None, None])
+                & (
+                    (lt_next[:, :, :, None] != self.ETERM[None, None, None])
+                    | (lv_next[:, :, :, None] != self.EVAL[None, None, None])
+                )
             )
-        )
-        updated = (append_new | conflict).astype(I32)  # [B, s, l, e]
-        # delta_lt[b,s,j,l,e] = (KEEPX-1)*lt[j] + AT*ETERM[e]
-        d_lt_j = (self.KEEPX[None, None] - 1) * lt[:, :, :, None, None] + (
-            self.AT[None, None] * self.ETERM[None, None, None, None]
-        )
-        d_lv_j = (self.KEEPX[None, None] - 1) * lv[:, :, :, None, None] + (
-            self.AT[None, None] * self.EVAL[None, None, None, None]
-        )
-        d_log7 = jnp.einsum(
-            "bsjle,sjpc->bslepc", d_lt_j.astype(U32), self.C_lt
-        ) + jnp.einsum("bsjle,sjpc->bslepc", d_lv_j.astype(U32), self.C_lv)
-        d_ll7 = dmul(
-            self.C_ll[:, None, None], self.NL[None, None] - ll[:, :, None, None]
-        )
-        d_upd7 = (d_log7 + d_ll7) * updated.astype(U32)[..., None, None]
-        # commitIndex := max(ci, min(lc, new_len)) — unconditional
-        d_ci7 = dmul(
-            self.C_ci[:, None, None, None],
-            jnp.maximum(ci[:, :, None, None, None], self.MINLC[None, None])
-            - ci[:, :, None, None, None],
-        )  # [B, s, l, e, h, P, C]
-        # success AppendResp s -> src at cur with prevLogIndex PI[l, e]
-        oh_pi = _oh(self.PI - 1, L)  # [l, e, L]
-        resp_present7 = jnp.einsum(
-            "bqtj,scq,bst,lej->bscle", ap1, self.SELP, oh_ct, oh_pi
-        )
-        rest7 = ((tcur1 - 1)[:, :, None, None] * L + (self.PI[None, None] - 1)) * 2 + 1
-        dmsg7 = self._add_msg(
-            self._pair_ab[:, :, None, None],  # [s, c, 1, 1] pair(s->c)
-            3,
-            jnp.broadcast_to(rest7[:, :, None], (B, S, S, L, E)),
-            1 - resp_present7,
-        )  # [B, s, c, l, e, P, C]
-        dh7 = (
-            d_upd7[:, :, None, :, :, None]
-            + d_ci7[:, :, None]
-            + dmsg7[:, :, :, :, :, None]
-        )
-        emit(
-            valid7,
-            jnp.ones((B, S, S, L, E, L), I32),
-            jnp.broadcast_to(dh7, (B, S, S, L, E, L, P, NC)),
-        )
+            updated = (append_new | conflict).astype(I32)  # [B, s, l, e]
+            # delta_lt[b,s,j,l,e] = (KEEPX-1)*lt[j] + AT*ETERM[e]
+            d_lt_j = (self.KEEPX[None, None] - 1) * lt[:, :, :, None, None] + (
+                self.AT[None, None] * self.ETERM[None, None, None, None]
+            )
+            d_lv_j = (self.KEEPX[None, None] - 1) * lv[:, :, :, None, None] + (
+                self.AT[None, None] * self.EVAL[None, None, None, None]
+            )
+            d_log7 = jnp.einsum(
+                "bsjle,sjpc->bslepc", d_lt_j.astype(U32), self.C_lt
+            ) + jnp.einsum("bsjle,sjpc->bslepc", d_lv_j.astype(U32), self.C_lv)
+            d_ll7 = dmul(
+                self.C_ll[:, None, None], self.NL[None, None] - ll[:, :, None, None]
+            )
+            d_upd7 = (d_log7 + d_ll7) * updated.astype(U32)[..., None, None]
+            # commitIndex := max(ci, min(lc, new_len)) — unconditional
+            d_ci7 = dmul(
+                self.C_ci[:, None, None, None],
+                jnp.maximum(ci[:, :, None, None, None], self.MINLC[None, None])
+                - ci[:, :, None, None, None],
+            )  # [B, s, l, e, h, P, C]
+            # success AppendResp s -> src at cur with prevLogIndex PI[l, e]
+            oh_pi = _oh(self.PI - 1, L)  # [l, e, L]
+            resp_present7 = jnp.einsum(
+                "bqtj,scq,bst,lej->bscle", ap1, self.SELP, oh_ct, oh_pi
+            )
+            rest7 = (
+                (tcur1 - 1)[:, :, None, None] * L + (self.PI[None, None] - 1)
+            ) * 2 + 1
+            dmsg7 = self._add_msg(
+                self._pair_ab[:, :, None, None],  # [s, c, 1, 1] pair(s->c)
+                3,
+                jnp.broadcast_to(rest7[:, :, None], (B, S, S, L, E)),
+                1 - resp_present7,
+            )  # [B, s, c, l, e, P, C]
+            dh7 = jnp.broadcast_to(
+                d_upd7[:, :, None, :, :, None]
+                + d_ci7[:, :, None]
+                + dmsg7[:, :, :, :, :, None],
+                (B, S, S, L, E, L, P, NC),
+            )
+        emit(valid7, jnp.ones((B, S, S, L, E, L), I32), dh7)
 
         # ---- F8 FollowerRejectEntry(s, src, pli)  axes [B, s, c, l] ------
         tot8 = jnp.einsum(
@@ -483,14 +511,14 @@ class DenseExpand:
             & (cnt8 > 0)
             & (rej_bit == 0)
         )
-        rest8 = jnp.broadcast_to(
-            ((tcur1 - 1)[:, :, None, None] * L + jnp.arange(L, dtype=I32)) * 2,
-            (B, S, S, L),
-        )
-        dmsg8 = self._add_msg(
-            self._pair_ab[:, :, None], 3, rest8, 1 - rej_bit
-        )
-        emit(valid8, cnt8, dmsg8)
+        dh8 = None
+        if want_fp:
+            rest8 = jnp.broadcast_to(
+                ((tcur1 - 1)[:, :, None, None] * L + jnp.arange(L, dtype=I32)) * 2,
+                (B, S, S, L),
+            )
+            dh8 = self._add_msg(self._pair_ab[:, :, None], 3, rest8, 1 - rej_bit)
+        emit(valid8, cnt8, dh8)
 
         # ---- F9 HandleAppendResp(s, src, pli, succ)  [B, s, c, l, x] -----
         bit9 = jnp.einsum("bqtlx,csq,bst->bsclx", ap, self.SELP, oh_ct)
@@ -508,17 +536,20 @@ class DenseExpand:
             & (bit9 > 0)
             & ok9
         )
-        x_ax = jnp.arange(2, dtype=I32)
-        d_mi9 = dmul(
-            self.C_mi[:, :, None, None],
-            x_ax * (pli9[..., None] - mi_sc[..., None]),
-        )
-        d_ni9 = dmul(
-            self.C_ni[:, :, None, None],
-            pli9[..., None] + x_ax - ni_sc[..., None],
-        )
-        d_p9 = dmul(self.C_pend[:, :, None, None], -pend[:, :, :, None, None])
-        emit(valid9, jnp.ones((B, S, S, L, 2), I32), d_mi9 + d_ni9 + d_p9)
+        dh9 = None
+        if want_fp:
+            x_ax = jnp.arange(2, dtype=I32)
+            d_mi9 = dmul(
+                self.C_mi[:, :, None, None],
+                x_ax * (pli9[..., None] - mi_sc[..., None]),
+            )
+            d_ni9 = dmul(
+                self.C_ni[:, :, None, None],
+                pli9[..., None] + x_ax - ni_sc[..., None],
+            )
+            d_p9 = dmul(self.C_pend[:, :, None, None], -pend[:, :, :, None, None])
+            dh9 = d_mi9 + d_ni9 + d_p9
+        emit(valid9, jnp.ones((B, S, S, L, 2), I32), dh9)
 
         # ---- F10 LeaderCanCommit(s)  axes [B, s] -------------------------
         # median_index-th order statistic without a sort op: the stable
@@ -533,16 +564,18 @@ class DenseExpand:
         )
         med = (mi * (pos == cfg.median_index)).sum(-1, dtype=I32)
         valid10 = (role == LEADER) & (med > ci)
-        dh10 = dmul(self.C_ci, med - ci)
+        dh10 = dmul(self.C_ci, med - ci) if want_fp else None
         emit(valid10, jnp.ones((B, S), I32), dh10)
 
         # ---- F11 Restart(s)  axes [B, s] ---------------------------------
         valid11 = (role == LEADER) & (rc[:, None] < cfg.max_restart)
-        dh11 = dmul(self.C_role, FOLLOWER - role) + self.C_rc
+        dh11 = (dmul(self.C_role, FOLLOWER - role) + self.C_rc) if want_fp else None
         emit(valid11, jnp.ones((B, S), I32), dh11)
 
         valid = jnp.concatenate(valid_parts, axis=1)
         mult = jnp.concatenate(mult_parts, axis=1)
+        if not want_fp:
+            return valid, mult, None, None, abort
         fpv = jnp.concatenate(fpv_parts, axis=1)
         fpf = jnp.concatenate(fpf_parts, axis=1)
         return valid, mult, fpv, fpf, abort

@@ -265,7 +265,10 @@ class Fingerprinter:
 
     N_CHAN = 4
 
-    def __init__(self, cfg: RaftConfig, seed: int = _SEED):
+    def __init__(
+        self, cfg: RaftConfig, seed: int = _SEED, force_factored: bool | None = None
+    ):
+        self._force_factored = force_factored
         self.cfg = cfg
         self.uni: MsgUniverse = get_universe(cfg)
         self.spec = FeatureSpec(cfg)
@@ -277,37 +280,88 @@ class Fingerprinter:
         rng = np.random.default_rng(seed)
         self.seed = np.uint32(seed)
         C = rng.integers(0, 1 << 32, size=(self.N_CHAN, F), dtype=np.uint32)
-        # message coefficients are COMPUTED (see _mix32 above) so successor
-        # kernels can evaluate them arithmetically; materialize the matrix
-        # host-side for the full-state matmul path.  raw_msg_coef is the
-        # single definition both paths share.
-        G = np.moveaxis(self.raw_msg_coef(np.arange(M, dtype=np.uint32)), -1, 0)
         if cfg.use_view:
             C[0:2, self.spec.F_view :] = 0  # aux vars excluded from view hash
 
-        # Fold every permutation into the coefficient tables.
+        # Fold every permutation into the feature-coefficient table
+        # (Cp is [P, chan, F] — 22 MB even at S=7, always affordable).
         Cp = np.empty((P, self.N_CHAN, F), np.uint32)
-        Gp = np.empty((P, self.N_CHAN, M), np.uint32)
-        pt = self.uni.perm_table  # int32[P, M]: message id under each perm
         for pi, p in enumerate(self.perms):
             pi_src = self.spec.perm_source_indices(p)
             # h_p(v) = sum_d C[d] v[pi_src[d]] = sum_e Cp[e] v[e]
             Cp[pi][:, pi_src] = C
-            Gp[pi] = G[:, pt[pi]]
 
         # Device tables. Plane matmul layout: columns = (P, chan, byte).
         self.C_planes = jnp.asarray(
             _u32_to_i8_planes(Cp).transpose(2, 0, 1, 3).reshape(F, P * self.N_CHAN * 4)
         )
-        self.G_planes = jnp.asarray(
-            _u32_to_i8_planes(Gp).transpose(2, 0, 1, 3).reshape(M, P * self.N_CHAN * 4)
-        )
+
+        # Message-set hash: the permutation-folded table Gp is [P, chan, M]
+        # u32 — fine at small symmetry groups (S=3: 0.5 MB, S=5: 30 MB) but
+        # 2.7 GB at S=7 (P=5040).  Above a budget, switch to the pair-block
+        # factorization (docs/SCALING.md): a server permutation moves ONLY
+        # the (src,dst)-pair digit of a message id, so the per-permutation
+        # set hash factors through per-type [stride, NP, chan] tables plus
+        # one exact one-hot P-fold matmul — nothing P-sized ever crosses M.
+        self.factored_msgs = P * self.N_CHAN * M * 4 > (64 << 20)
+        if self._force_factored is not None:
+            self.factored_msgs = self._force_factored
+        if not self.factored_msgs:
+            # message coefficients are COMPUTED (see _mix32 above) so
+            # successor kernels can evaluate them arithmetically;
+            # materialize the matrix host-side for the full-state matmul
+            # path.  raw_msg_coef is the single definition both paths share.
+            G = np.moveaxis(self.raw_msg_coef(np.arange(M, dtype=np.uint32)), -1, 0)
+            Gp = np.empty((P, self.N_CHAN, M), np.uint32)
+            pt = self.uni.perm_table  # int32[P, M]: message id under each perm
+            for pi in range(P):
+                Gp[pi] = G[:, pt[pi]]
+            self.G_planes = jnp.asarray(
+                _u32_to_i8_planes(Gp).transpose(2, 0, 1, 3).reshape(M, P * self.N_CHAN * 4)
+            )
+            self._Gp_np = Gp
+        else:
+            self._build_pair_block_tables()
         # tiny constants for the arithmetic delta path
         self._pair_perm = jnp.asarray(self.uni.pair_perm_table)  # [P, S(S-1)]
         self._type_offsets = self.uni.type_offsets
         self._type_strides = self.uni.type_strides
         # Host copies for the numpy reference path.
-        self._Cp_np, self._Gp_np = Cp, Gp
+        self._Cp_np = Cp
+
+    def _build_pair_block_tables(self):
+        """Per-type pair-block coefficient tables + the P-fold one-hot.
+
+        For type t, every id is ``off_t + q*stride_t + rest`` and a server
+        permutation p maps it to ``off_t + PPERM[p,q]*stride_t + rest``.
+        ``Gt[t][rest, q'*chan*4 + ...]`` holds the i8 planes of the
+        coefficient at pair digit q'; the state's per-(q,q') partial sums
+        R then fold over permutations with a [P, NP*NP] one-hot matmul
+        whose integer values stay < 2^24, so it runs exactly in f32 on
+        the MXU (see _msg_hash_factored)."""
+        uni = self.uni
+        NP = uni.S * (uni.S - 1)
+        self._NP = NP
+        self._Gt_planes = []
+        for off, stride in zip(uni.type_offsets, uni.type_strides):
+            q = np.arange(NP, dtype=np.uint32)[:, None]
+            r = np.arange(stride, dtype=np.uint32)[None, :]
+            ids = np.uint32(off) + q * np.uint32(stride) + r  # [NP, stride]
+            coef = self.raw_msg_coef(ids)  # u32 [NP, stride, chan]
+            planes = _u32_to_i8_planes(coef)  # i8 [NP, stride, chan, 4]
+            self._Gt_planes.append(
+                jnp.asarray(
+                    planes.transpose(1, 0, 2, 3).reshape(
+                        stride, NP * self.N_CHAN * 4
+                    )
+                )
+            )
+        pp = self.uni.pair_perm_table  # [P, NP]
+        oh = np.zeros((self.P, NP * NP), np.float32)
+        rows = np.repeat(np.arange(self.P), NP)
+        cols = (np.tile(np.arange(NP), self.P) * NP + pp.ravel())
+        oh[rows, cols] = 1.0
+        self._ppfold = jnp.asarray(oh)  # f32 [P, NP*NP]
 
     # -- the ONE definition of the computed message coefficient ------------
 
@@ -352,7 +406,41 @@ class Fingerprinter:
 
     def msg_hash(self, packed: jnp.ndarray) -> jnp.ndarray:
         """packed u32[..., n_words] -> set-hash u32[..., P, chan]."""
+        if self.factored_msgs:
+            return self._msg_hash_factored(packed)
         return self._plane_matmul(self.unpack_bits(packed), self.G_planes)
+
+    def _msg_hash_factored(self, packed: jnp.ndarray) -> jnp.ndarray:
+        """Pair-block set hash: per-type partial sums + one P-fold matmul.
+
+        Bit-identical to the monolithic ``bits @ G_planes`` path (the
+        plane combine is linear mod 2^32 and commutes with the fold; the
+        f32 fold matmul is exact because every partial sum and every
+        folded sum stays below 2^24 — |plane| <= 127, sum of strides
+        <= ~10^3, NP <= 42 terms per output)."""
+        uni, NP, NC = self.uni, self._NP, self.N_CHAN
+        bits = self.unpack_bits(packed)  # i8 [..., M]
+        lead = bits.shape[:-1]
+        R = None
+        for (off, stride), Gt in zip(
+            zip(uni.type_offsets, uni.type_strides), self._Gt_planes
+        ):
+            bt = bits[..., off : off + NP * stride].reshape(*lead, NP, stride)
+            if jax.default_backend() == "cpu":
+                Rt = jnp.dot(bt.astype(jnp.int32), Gt.astype(jnp.int32))
+            else:
+                Rt = jnp.dot(bt, Gt, preferred_element_type=jnp.int32)
+            R = Rt if R is None else R + Rt  # [..., NP(q), NP(q')*chan*4]
+        A = R.reshape(*lead, NP * NP, NC * 4).astype(jnp.float32)
+        # precision=HIGHEST: the exactness argument needs true f32
+        # accumulation — default matmul precision on TPU is bf16 passes,
+        # which would silently round the >2^8 partial sums
+        folded = jnp.einsum(
+            "...mx,pm->...px", A, self._ppfold,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        planes = jnp.round(folded).astype(jnp.int32)
+        return _combine_planes_u32(planes.reshape(*lead, self.P, NC, 4))
 
     def delta_hash(self, ids: jnp.ndarray, live: jnp.ndarray) -> jnp.ndarray:
         """Added-message contribution: ids i32[..., A], live bool[..., A].
@@ -448,15 +536,35 @@ class Fingerprinter:
         feats = self.spec.features_np(arrs)  # i64[N, F]
         # sum_e feat[e] * Cp  with the same signed-byte-plane linearization.
         cp = _u32_to_i8_planes(self._Cp_np).astype(np.int64)  # [P, chan, F, 4]
-        gp = _u32_to_i8_planes(self._Gp_np).astype(np.int64)
-        planes = np.einsum("nf,pcfk->npck", feats, cp) + np.einsum(
-            "nm,pcmk->npck", msgs_bits.astype(np.int64), gp
-        )
+        planes = np.einsum("nf,pcfk->npck", feats, cp)
+        if self.factored_msgs:
+            planes = planes + self._msg_planes_factored_np(msgs_bits)
+        else:
+            gp = _u32_to_i8_planes(self._Gp_np).astype(np.int64)
+            planes = planes + np.einsum(
+                "nm,pcmk->npck", msgs_bits.astype(np.int64), gp
+            )
         h = _combine_planes_u32(planes)  # u32[N, P, chan]
         h64 = h.astype(np.uint64)
         view = ((h64[..., 0] << np.uint64(32)) | h64[..., 1]).min(axis=-1)
         full = ((h64[..., 2] << np.uint64(32)) | h64[..., 3]).min(axis=-1)
         return view, full
+
+    def _msg_planes_factored_np(self, msgs_bits: np.ndarray) -> np.ndarray:
+        """Exact int64 twin of _msg_hash_factored -> planes i64[N, P, chan, 4]."""
+        uni, NP, NC = self.uni, self._NP, self.N_CHAN
+        bits = msgs_bits.astype(np.int64)
+        R = None
+        for (off, stride), Gt in zip(
+            zip(uni.type_offsets, uni.type_strides), self._Gt_planes
+        ):
+            bt = bits[:, off : off + NP * stride].reshape(-1, NP, stride)
+            Rt = bt @ np.asarray(Gt).astype(np.int64)  # [N, q, q'*chan*4]
+            R = Rt if R is None else R + Rt
+        A = R.reshape(R.shape[0], NP * NP, NC * 4)
+        oh = np.asarray(self._ppfold).astype(np.int64)  # [P, NP*NP]
+        folded = np.einsum("nmx,pm->npx", A, oh)
+        return folded.reshape(-1, self.P, NC, 4)
 
 
 @functools.lru_cache(maxsize=8)

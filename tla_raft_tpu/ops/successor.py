@@ -280,11 +280,21 @@ class SuccessorKernel:
         self.dense = DenseExpand(cfg, self.uni, self.fpr)
         self.expand = jax.jit(self._expand_dense)
         self.expand_reference = jax.jit(self._expand)
+        self.expand_guards = jax.jit(self._expand_guards)
         self.materialize = jax.jit(self._materialize)
 
     def _expand_dense(self, st: RaftState, msum: jnp.ndarray) -> Expansion:
         valid, mult, fpv, fpf, abort = self.dense(st, msum)
         return Expansion(valid, mult & jnp.where(valid, -1, 0), fpv, fpf, abort)
+
+    def _expand_guards(self, st: RaftState):
+        """Guards-only pass 1: (valid bool[B,K], mult i32[B,K], abort bool[B]).
+
+        No fingerprint work and no P-wide symmetry fold — the engine's
+        late-canonicalization path (engine/bfs.py) fingerprints only the
+        compacted candidates from their materialized states."""
+        valid, mult, _fpv, _fpf, abort = self.dense(st, None, want_fp=False)
+        return valid, mult & jnp.where(valid, -1, 0), abort
 
     # -- scalar action transcriptions -------------------------------------
     # Each takes (st: RaftState with no batch dim, c: i32[5]) and returns

@@ -46,6 +46,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import RaftConfig
+from ..engine.bfs import _compact_payloads
 from ..engine.invariants import resolve_invariant_kernel
 from ..models.raft import RaftState, init_batch, to_oracle
 from ..ops.successor import get_kernel
@@ -122,8 +123,16 @@ class ShardedChecker:
         vcap: int = 1 << 16,
         exchange: str = "all_to_all",
         progress=None,
+        canon: str = "late",
     ):
         assert exchange in ("all_to_all", "all_gather")
+        # canon="late" (default): guards-only expand, then materialize +
+        # full-state-fingerprint only the compacted candidates — no
+        # P-sized per-lane intermediates and no per-state msum carried in
+        # the frontier (see engine/bfs.py).  canon="expand": the round-2
+        # per-lane incremental-hash formulation, kept as a reference.
+        assert canon in ("late", "expand")
+        self.canon = canon
         self.cfg = cfg
         self.mesh = mesh
         self.kern = get_kernel(cfg)
@@ -144,28 +153,50 @@ class ShardedChecker:
         cap_f = frontier.voted_for.shape[0]
         dev = jax.lax.axis_index("d").astype(I64)
 
-        exp = self.kern.expand(frontier, msum)
+        if self.canon == "late":
+            valid, mult, ab_state = self.kern.expand_guards(frontier)
+        else:
+            exp = self.kern.expand(frontier, msum)
+            valid, mult, ab_state = exp.valid, exp.mult, exp.abort
         in_range = (jnp.arange(cap_f) < n_f[0])[:, None]
-        valid = exp.valid & in_range
-        fpv = jnp.where(valid, exp.fp_view, SENT).ravel()
-        fpf = jnp.where(valid, exp.fp_full, SENT).ravel()
+        valid = valid & in_range
         gparent = dev * cap_f + jnp.arange(cap_f, dtype=I64)
         payload = (gparent[:, None] * K + jnp.arange(K, dtype=I64)[None]).ravel()
         mult_slots = jax.lax.psum(
-            jnp.where(valid, exp.mult, 0).astype(I64).sum(0), "d"
+            jnp.where(valid, mult, 0).astype(I64).sum(0), "d"
         )
-        abort_local = exp.abort & in_range[:, 0]
+        abort_local = ab_state & in_range[:, 0]
         abort = jax.lax.psum(abort_local.any().astype(I32), "d") > 0
         abort_at = jnp.where(
             abort_local.any(), jnp.argmax(abort_local), -1
         ).astype(I64)
+
+        if self.canon == "late":
+            # compact the valid (parent, slot) lanes, materialize them
+            # locally, and fingerprint the children from their full
+            # states — the symmetry fold runs over cap_x candidates, not
+            # cap_f*K fan-out lanes (see engine/bfs.py)
+            cp_raw, lane, overflow = _compact_payloads(
+                valid.ravel(), payload, self.cap_x
+            )
+            lidx = ((cp_raw // K) % cap_f).astype(I32)
+            parents = jax.tree.map(lambda x: x[lidx], frontier)
+            children = self.kern.materialize(parents, cp_raw % K)
+            fv, ff, _msum = self.fpr.state_fingerprints(children)
+            fpv = jnp.where(lane, fv.astype(U64), SENT)
+            fpf = jnp.where(lane, ff.astype(U64), SENT)
+            payload = jnp.where(lane, cp_raw, -1)
+        else:
+            fpv = jnp.where(valid, exp.fp_view, SENT).ravel()
+            fpf = jnp.where(valid, exp.fp_full, SENT).ravel()
 
         # local pre-dedup: min (fp_full, payload) representative per view fp
         order = jnp.lexsort((payload, fpf, fpv))
         sv, sf, sp = fpv[order], fpf[order], payload[order]
         first = jnp.concatenate([jnp.ones((1,), bool), sv[1:] != sv[:-1]])
         keep = first & (sv != SENT)
-        overflow = keep.sum() > self.cap_x
+        if self.canon != "late":
+            overflow = keep.sum() > self.cap_x
         cv, cf, cp, _lane = _compact(
             keep, self.cap_x, sv, sf, sp, fills=(SENT, SENT, I64(-1))
         )
@@ -178,7 +209,14 @@ class ShardedChecker:
         slots = wpay % K
         parents = jax.tree.map(lambda x: x[pidx], frontier)
         children = self.kern.materialize(parents, slots)
-        child_msum = self.fpr.msg_hash(children.msgs)
+        # the per-state message-set hash partial is only carried between
+        # levels by the canon="expand" incremental path; it is P-sized
+        # per state, so the late path keeps a [cap, 1, 1] dummy instead
+        child_msum = (
+            self.fpr.msg_hash(children.msgs)
+            if self.canon == "expand"
+            else jnp.zeros((children.voted_for.shape[0], 1, 1), jnp.uint32)
+        )
         children = jax.tree.map(
             lambda x: jnp.where(
                 wlane.reshape((-1,) + (1,) * (x.ndim - 1)), x, jnp.zeros_like(x)
@@ -238,8 +276,8 @@ class ShardedChecker:
             self._expand_local(frontier, msum, n_f)
         )
         # --- route to owners ---------------------------------------------
-        # sentinel lanes route to a virtual discard row D so they neither
-        # count toward a real bucket nor collide with real scatters
+        # sentinel lanes sort to a virtual group D past every real owner,
+        # so they never land in a send row
         owner = jnp.where(cv == SENT, D, (cv % jnp.uint64(D)).astype(I64))
         oorder = jnp.argsort(owner, stable=True)  # candidates grouped by owner
         ov, of_, op, oo = cv[oorder], cf[oorder], cp[oorder], owner[oorder]
@@ -247,15 +285,21 @@ class ShardedChecker:
         starts = jnp.cumsum(counts) - counts
         rank = jnp.arange(cap_x) - starts[oo]
         overflow_x = overflow | (counts[:D].max() > cap_r)
-        # scatter into the [D+1, cap_r] send buffer; slice off the discard row
-        sendv = jnp.full((D + 1, cap_r), SENT, U64)
-        sendf = jnp.full((D + 1, cap_r), SENT, U64)
-        sendp = jnp.full((D + 1, cap_r), -1, I64)
         rr = jnp.clip(rank, 0, cap_r - 1)
         ok_lane = (ov != SENT) & (rank < cap_r)
-        sendv = sendv.at[oo, rr].set(jnp.where(ok_lane, ov, SENT))[:D]
-        sendf = sendf.at[oo, rr].set(jnp.where(ok_lane, of_, SENT))[:D]
-        sendp = sendp.at[oo, rr].set(jnp.where(ok_lane, op, -1))[:D]
+        # gather-based send-buffer build (no dynamic scatters on the mesh
+        # path — XLA:TPU miscompiled this op class in the materialize pass,
+        # docs/PERF.md): row o reads the owner-grouped lanes
+        # starts[o] .. starts[o]+cap_r-1, masked to counts[o] entries
+        idx = jnp.clip(
+            starts[:D, None] + jnp.arange(cap_r, dtype=starts.dtype)[None, :],
+            0,
+            cap_x - 1,
+        )
+        in_row = jnp.arange(cap_r)[None, :] < counts[:D, None]
+        sendv = jnp.where(in_row, ov[idx], SENT)
+        sendf = jnp.where(in_row, of_[idx], SENT)
+        sendp = jnp.where(in_row, op[idx], -1)
         rv = jax.lax.all_to_all(sendv, "d", 0, 0, tiled=True).reshape(D, cap_r)
         rf = jax.lax.all_to_all(sendf, "d", 0, 0, tiled=True).reshape(D, cap_r)
         rp = jax.lax.all_to_all(sendp, "d", 0, 0, tiled=True).reshape(D, cap_r)
@@ -276,8 +320,8 @@ class ShardedChecker:
             jnp.concatenate([visited, jnp.where(qnew, qsv, SENT)])
         )[: visited.shape[0]]
         # verdict bits back to origins, aligned to the recv layout
-        verdict_sorted = qnew
-        verdict = jnp.zeros(qv.shape[0], bool).at[qorder].set(verdict_sorted)
+        # (inverse-permutation gather, not a scatter)
+        verdict = qnew[jnp.argsort(qorder)]
         back = jax.lax.all_to_all(
             verdict.reshape(D, cap_r), "d", 0, 0, tiled=True
         ).reshape(D, cap_r)
@@ -377,7 +421,8 @@ class ShardedChecker:
             mult_slots=mult_slots_total,
             meta=np.asarray(
                 [self.D, distinct, generated, depth,
-                 1 if self.exchange == "all_to_all" else 0],
+                 1 if self.exchange == "all_to_all" else 0,
+                 1 if self.canon == "late" else 0],
                 np.int64,
             ),
             level_sizes=np.asarray(level_sizes, np.int64),
@@ -388,7 +433,8 @@ class ShardedChecker:
 
     def _load_checkpoint(self, path, shard, repl):
         z = np.load(path)
-        D, distinct, generated, depth, a2a = (int(x) for x in z["meta"])
+        meta = [int(x) for x in z["meta"]]
+        D, distinct, generated, depth, a2a = meta[:5]
         if D != self.D:
             raise ValueError(
                 f"checkpoint was taken on a {D}-device mesh, this run has "
@@ -396,6 +442,14 @@ class ShardedChecker:
             )
         if a2a != (1 if self.exchange == "all_to_all" else 0):
             raise ValueError("checkpoint exchange mode differs from this run")
+        # the canon="late" frontier carries a dummy msum that the
+        # canon="expand" incremental hash would silently consume as zeros
+        late = meta[5] if len(meta) > 5 else 0
+        if late != (1 if self.canon == "late" else 0):
+            raise ValueError(
+                "checkpoint canonicalization mode differs from this run "
+                "(pass the matching --canon)"
+            )
         frontier = RaftState(
             **{
                 k[3:]: jax.device_put(jnp.asarray(z[k]), shard)
@@ -455,6 +509,8 @@ class ShardedChecker:
         else:
             frontier = jax.device_put(init_batch(cfg, D), shard)
             fv, _ff, msum0 = self.fpr.state_fingerprints(frontier)
+            if self.canon == "late":
+                msum0 = jnp.zeros((D, 1, 1), jnp.uint32)
             msum = jax.device_put(msum0, shard)
             n_f = jax.device_put(jnp.asarray([1] + [0] * (D - 1), I64), shard)
             fp0 = np.asarray(fv.astype(U64))[0]
