@@ -1,0 +1,114 @@
+"""Per-stage timing of one deep BFS level from a checkpoint.
+
+Unlike profile_level.py (which re-runs from Init), this loads a
+``states/latest.npz`` checkpoint — multi-million-state frontiers are
+reached in seconds — and times every stage of the next level with
+block_until_ready fences: chunk expands, group filters, the level-wide
+dedup sort, materialize slices, the visited merge, and the
+checkpoint-save host cost.  Drives the deep-sweep optimization work
+(the full-space sweep spends ~all its wall-clock past level 20).
+
+Usage: PYTHONPATH=. python scripts/profile_deep.py [ckpt] [chunk] [n_chunks_cap]
+"""
+
+import sys
+import time
+
+ckpt = sys.argv[1] if len(sys.argv) > 1 else "states/latest.npz"
+chunk = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
+cap_chunks = int(sys.argv[3]) if len(sys.argv) > 3 else 0  # 0 = all
+
+import os
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/tla_raft_tpu_jax")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.engine.bfs import I64, _pow2
+
+cfg = load_raft_config("/root/reference/Raft.cfg")
+canon = os.environ.get("PROFILE_CANON", "late")
+chk = JaxChecker(cfg, chunk=chunk, canon=canon)
+print("backend:", jax.default_backend(), "chunk:", chunk, "canon:", canon)
+
+ck = chk._load_checkpoint(ckpt)
+frontier, visited, n_f = ck["frontier"], ck["visited"], ck["n_f"]
+print(
+    f"checkpoint: depth {ck['depth']}, frontier {n_f}, "
+    f"distinct {ck['distinct']}, visited cap {visited.shape[0]}"
+)
+if cap_chunks:
+    n_f = min(n_f, cap_chunks * chunk)
+    print(f"capping to first {n_f} frontier states ({cap_chunks} chunks)")
+
+# frontier capacity must be a chunk multiple (run() does this too)
+from tla_raft_tpu.engine.bfs import _pad_axis0
+
+if frontier.voted_for.shape[0] % chunk:
+    cap0 = -(-frontier.voted_for.shape[0] // chunk) * chunk
+    frontier = jax.tree.map(lambda x: _pad_axis0(x, cap0), frontier)
+
+times = {}
+counts = {}
+
+
+def wrap(name, fn):
+    def timed(*a, **kw):
+        t0 = time.monotonic()
+        out = fn(*a, **kw)
+        jax.block_until_ready(out)
+        times[name] = times.get(name, 0.0) + (time.monotonic() - t0)
+        counts[name] = counts.get(name, 0) + 1
+        return out
+
+    return timed
+
+
+chk._expand_chunk = wrap("expand_chunk", chk._expand_chunk)
+chk._mat_slice = wrap("mat_slice", chk._mat_slice)
+
+import tla_raft_tpu.engine.bfs as bfs
+
+orig_group = bfs._group_filter
+orig_dedup = bfs._level_dedup
+orig_merge = bfs._merge_sorted
+bfs._group_filter = wrap("group_filter", orig_group)
+bfs._level_dedup = wrap("level_dedup", orig_dedup)
+bfs._merge_sorted = wrap("merge_sorted", orig_merge)
+
+t0 = time.monotonic()
+(n_new, new_fps, new_payload, abort_at, overflow, overflow_g, mult) = (
+    chk._expand_level(frontier, int(n_f), visited)
+)
+t_expand_level = time.monotonic() - t0
+print(f"\n_expand_level total: {t_expand_level:.1f}s  n_new={n_new}")
+
+# materialize survivors
+t0 = time.monotonic()
+sl = min(4 * chunk, new_payload.shape[0])
+n_slices = -(-max(n_new, 1) // sl)
+parts = []
+for si in range(n_slices):
+    pay_slice = jax.lax.dynamic_slice_in_dim(new_payload, si * sl, sl)
+    parts.append(chk._mat_slice(frontier, pay_slice, jnp.asarray(min(sl, n_new - si * sl), I64)))
+jax.block_until_ready(parts)
+t_mat = time.monotonic() - t0
+print(f"materialize {n_new} survivors in {n_slices} slices: {t_mat:.1f}s")
+
+t0 = time.monotonic()
+vis2 = bfs._merge_sorted(visited, new_fps[: max(_pow2(max(n_new, 1)), chunk)])
+jax.block_until_ready(vis2)
+t_merge = time.monotonic() - t0
+print(f"visited merge: {t_merge:.1f}s")
+
+print("\nper-stage totals (s) and call counts:")
+for k in sorted(times, key=lambda k: -times[k]):
+    print(f"  {k:14s} {times[k]:8.1f}  x{counts[k]}  ({times[k]/max(counts[k],1)*1000:.0f} ms/call)")
