@@ -20,12 +20,9 @@ cap_chunks = int(sys.argv[3]) if len(sys.argv) > 3 else 0  # 0 = all
 
 import os
 
-import jax
+from tla_raft_tpu.platform import setup_jax
 
-jax.config.update(
-    "jax_compilation_cache_dir", os.path.expanduser("~/.cache/tla_raft_tpu_jax")
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax = setup_jax()
 
 import jax.numpy as jnp
 import numpy as np
@@ -60,11 +57,18 @@ times = {}
 counts = {}
 
 
+def force(out):
+    """Force completion with a host fetch: block_until_ready does NOT
+    block on the tunneled device (docs/PERF.md lesson 1)."""
+    leaf = jax.tree.leaves(out)[0]
+    np.asarray(leaf.ravel()[:1])
+    return out
+
+
 def wrap(name, fn):
     def timed(*a, **kw):
         t0 = time.monotonic()
-        out = fn(*a, **kw)
-        jax.block_until_ready(out)
+        out = force(fn(*a, **kw))
         times[name] = times.get(name, 0.0) + (time.monotonic() - t0)
         counts[name] = counts.get(name, 0) + 1
         return out
@@ -90,6 +94,12 @@ t0 = time.monotonic()
 )
 t_expand_level = time.monotonic() - t0
 print(f"\n_expand_level total: {t_expand_level:.1f}s  n_new={n_new}")
+if overflow or overflow_g:
+    print(
+        "WARNING: lane-budget overflow (cap_x/cap_g) — the run() driver "
+        "would grow the budget and REDO this level; these timings cover a "
+        "truncated expansion and must not be extrapolated"
+    )
 
 # materialize survivors
 t0 = time.monotonic()

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Crash-resilient full-space sweep of the reference config.
+#
+# The tunneled TPU worker occasionally dies mid-level ("TPU worker
+# process crashed or restarted", remote-compile connection drops); the
+# checker checkpoints every level, so this wrapper simply resumes until
+# the run exits cleanly.  Usage: scripts/run_sweep.sh [chunk] [canon]
+
+set -u
+cd "$(dirname "$0")/.."
+CHUNK="${1:-8192}"
+CANON="${2:-late}"
+CKPT=states/latest.npz
+TRIES=0
+MAX_TRIES=40
+
+while true; do
+  TRIES=$((TRIES + 1))
+  if [ "$TRIES" -gt "$MAX_TRIES" ]; then
+    echo "run_sweep: giving up after $MAX_TRIES attempts" >&2
+    exit 1
+  fi
+  RECOVER=()
+  [ -f "$CKPT" ] && RECOVER=(--recover "$CKPT")
+  echo "run_sweep: attempt $TRIES (recover: ${RECOVER[*]:-none})" >&2
+  python -m tla_raft_tpu.check \
+    --config /root/reference/Raft.cfg \
+    --chunk "$CHUNK" --canon "$CANON" \
+    --checkpoint-dir states --checkpoint-every 1 \
+    "${RECOVER[@]}" --json --log raft_sweep.log
+  RC=$?
+  if [ "$RC" -eq 0 ]; then
+    echo "run_sweep: clean completion" >&2
+    exit 0
+  fi
+  # rc=1 covers both crashes and genuine violations; a violation prints
+  # an "Error: ..." verdict + trace and must NOT be retried
+  if grep -q '^Error:' raft_sweep.log 2>/dev/null; then
+    echo "run_sweep: checker reported a violation (see raft_sweep.log);" \
+         "not a crash — stopping" >&2
+    exit "$RC"
+  fi
+  echo "run_sweep: rc=$RC; retrying in 30s" >&2
+  sleep 30
+done

@@ -200,15 +200,9 @@ def main(argv=None) -> int:
 
         res = OracleChecker(cfg).run(max_depth=args.max_depth)
     else:
-        import jax
+        from .platform import setup_jax
 
-        # persistent compile cache: the expand kernel is large and its
-        # compile (remote on tunneled TPUs) dominates cold-start time
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.expanduser("~/.cache/tla_raft_tpu_jax"),
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax = setup_jax()
 
         from .engine import JaxChecker
 

@@ -486,8 +486,7 @@ class JaxChecker:
             arrs[f"trace_p{i}"] = p
             arrs[f"trace_s{i}"] = s
         tmp = f"{path}.tmp.npz"
-        np.savez_compressed(
-            tmp,
+        payload = dict(
             visited=np.asarray(visited),
             mult_per_slot=mult_per_slot,
             meta=np.asarray([n_f, distinct, generated, depth], np.int64),
@@ -495,6 +494,11 @@ class JaxChecker:
             n_trace=np.asarray([len(trace_levels)], np.int64),
             **arrs,
         )
+        # zlib on multi-GB frontiers costs ~a minute of host time per
+        # level; past 256 MB the disk is cheaper than the CPU
+        total = sum(a.nbytes for a in payload.values())
+        save = np.savez_compressed if total < (256 << 20) else np.savez
+        save(tmp, **payload)
         os.replace(tmp, path)
 
     @staticmethod

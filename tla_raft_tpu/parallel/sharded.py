@@ -60,6 +60,14 @@ SENT = jnp.uint64(0xFFFFFFFFFFFFFFFF)
 def make_mesh(n_devices: int | None = None) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"requested a {n_devices}-device mesh but only "
+                f"{len(devs)} device(s) are visible "
+                f"({[str(d) for d in devs]}); for a virtual CPU mesh set "
+                "JAX_PLATFORMS=cpu and "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N"
+            )
         devs = devs[:n_devices]
     return Mesh(np.array(devs), axis_names=("d",))
 

@@ -1,0 +1,28 @@
+"""Shared JAX bootstrap for CLI entry points and scripts.
+
+Two platform quirks every entry point must handle (docs/PERF.md):
+
+* a sitecustomize may pin the accelerator platform via ``jax.config`` at
+  interpreter start, which silently beats the ``JAX_PLATFORMS`` env var —
+  re-assert the env var so ``JAX_PLATFORMS=cpu`` actually means CPU;
+* remote compilation on tunneled devices is minutes per shape — keep a
+  persistent compile cache.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def setup_jax():
+    """Apply platform override + compile cache; returns the jax module."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.expanduser("~/.cache/tla_raft_tpu_jax"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return jax
