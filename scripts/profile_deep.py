@@ -9,6 +9,10 @@ checkpoint-save host cost.  Drives the deep-sweep optimization work
 (the full-space sweep spends ~all its wall-clock past level 20).
 
 Usage: PYTHONPATH=. python scripts/profile_deep.py [ckpt] [chunk] [n_chunks_cap]
+
+``ckpt`` is either a monolith ``.npz`` snapshot or a delta-log
+checkpoint DIRECTORY (the format deep sweeps write); directories are
+replayed to rebuild the frontier.
 """
 
 import sys
@@ -36,7 +40,11 @@ canon = os.environ.get("PROFILE_CANON", "late")
 chk = JaxChecker(cfg, chunk=chunk, canon=canon)
 print("backend:", jax.default_backend(), "chunk:", chunk, "canon:", canon)
 
-ck = chk._load_checkpoint(ckpt)
+ck = (
+    chk._resume_from_deltas(ckpt)
+    if os.path.isdir(ckpt)
+    else chk._load_checkpoint(ckpt)
+)
 frontier, visited, n_f = ck["frontier"], ck["visited"], ck["n_f"]
 print(
     f"checkpoint: depth {ck['depth']}, frontier {n_f}, "

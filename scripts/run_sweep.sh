@@ -10,7 +10,7 @@ set -u
 cd "$(dirname "$0")/.."
 CHUNK="${1:-8192}"
 CANON="${2:-late}"
-CKPT=states/latest.npz
+CKDIR=states_delta
 TRIES=0
 MAX_TRIES=40
 
@@ -20,13 +20,17 @@ while true; do
     echo "run_sweep: giving up after $MAX_TRIES attempts" >&2
     exit 1
   fi
+  # resume from the delta-log directory once it holds anything (a base
+  # monolith or per-level delta files); first attempt starts fresh
   RECOVER=()
-  [ -f "$CKPT" ] && RECOVER=(--recover "$CKPT")
+  if ls "$CKDIR"/delta_*.npz >/dev/null 2>&1 || [ -f "$CKDIR/base.npz" ]; then
+    RECOVER=(--recover "$CKDIR")
+  fi
   echo "run_sweep: attempt $TRIES (recover: ${RECOVER[*]:-none})" >&2
   python -m tla_raft_tpu.check \
     --config /root/reference/Raft.cfg \
     --chunk "$CHUNK" --canon "$CANON" \
-    --checkpoint-dir states --checkpoint-every 1 \
+    --checkpoint-dir "$CKDIR" --checkpoint-every 1 \
     "${RECOVER[@]}" --json --log raft_sweep.log
   RC=$?
   if [ "$RC" -eq 0 ]; then

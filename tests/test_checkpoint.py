@@ -9,6 +9,7 @@ from tla_raft_tpu.oracle import OracleChecker
 
 
 def test_resume_matches_uninterrupted_run(tmp_path):
+    """Delta-log checkpoints: the resume replays materialize from Init."""
     cfg = RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1)
     want = OracleChecker(cfg).run()
 
@@ -17,10 +18,9 @@ def test_resume_matches_uninterrupted_run(tmp_path):
         max_depth=4, checkpoint_dir=ckdir, checkpoint_every=1
     )
     assert partial.depth == 4
-    ck = os.path.join(ckdir, "latest.npz")
-    assert os.path.exists(ck)
+    assert os.path.exists(os.path.join(ckdir, "delta_0004.npz"))
 
-    resumed = JaxChecker(cfg, chunk=64).run(resume_from=ck)
+    resumed = JaxChecker(cfg, chunk=64).run(resume_from=ckdir)
     assert resumed.ok == want.ok
     assert resumed.distinct == want.distinct
     assert resumed.depth == want.depth
@@ -28,3 +28,29 @@ def test_resume_matches_uninterrupted_run(tmp_path):
     # generated counts only the resumed levels' expansions plus the
     # checkpointed prefix recorded in the snapshot
     assert resumed.generated == want.generated
+
+
+def test_resume_preserves_violation_traces(tmp_path):
+    """A violation found after a delta-log resume still yields a genuine,
+    full-depth counterexample trace (the replay rebuilds every level's
+    (parent, slot) spill, not just the frontier)."""
+    from tla_raft_tpu.oracle.explicit import successors
+
+    cfg = RaftConfig(
+        n_servers=3, n_vals=1, max_election=1, max_restart=0,
+        invariants=("~RaftCanCommt",),
+    )
+    want = OracleChecker(cfg).run()
+    assert not want.ok
+
+    ckdir = str(tmp_path / "states")
+    JaxChecker(cfg, chunk=64).run(
+        max_depth=want.depth - 2, checkpoint_dir=ckdir, checkpoint_every=1
+    )
+    got = JaxChecker(cfg, chunk=64).run(resume_from=ckdir)
+    assert not got.ok
+    assert got.depth == want.depth
+    _kind, trace = got.violation
+    assert trace[0][0] == "Init"
+    for (_, a), (act, b) in zip(trace, trace[1:]):
+        assert any(ch == b for _n, _s, _d, ch in successors(cfg, a)), act

@@ -13,7 +13,7 @@ Usage:
   python -m tla_raft_tpu.check --config /root/reference/Raft.cfg \
       [--backend jax|oracle] [--max-depth N] [--chunk N] \
       [--invariant NAME]... [--no-symmetry] [--no-view] \
-      [--checkpoint-dir states] [--recover states/latest.npz] \
+      [--checkpoint-dir states] [--recover states] \
       [--log raft.log] [--servers N] [--vals N] [--max-election N] \
       [--max-restart N]
 
@@ -130,9 +130,18 @@ def main(argv=None) -> int:
     p.add_argument("--fpstore-dir", default=None,
                    help="use the native external-memory fingerprint store "
                         "(TLC's states/ spill analog) rooted at this dir")
-    p.add_argument("--checkpoint-dir", default=None)
-    p.add_argument("--checkpoint-every", type=int, default=1)
-    p.add_argument("--recover", default=None, help="resume from a checkpoint .npz")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="write per-level delta-log checkpoints here "
+                        "(single-device; the mesh backend writes a "
+                        "latest.npz monolith)")
+    p.add_argument("--checkpoint-every", type=int, default=1,
+                   help="single-device: 0 disables checkpointing, any "
+                        "other value records EVERY level (the delta-log "
+                        "replay chain cannot skip levels); mesh: save the "
+                        "monolith every N levels")
+    p.add_argument("--recover", default=None,
+                   help="resume from a checkpoint: the --checkpoint-dir "
+                        "directory (delta log) or a monolith .npz")
     p.add_argument("--mesh", type=int, default=0,
                    help="run distributed over an N-device mesh (0 = single device)")
     p.add_argument("--exchange", choices=("all_to_all", "all_gather"),

@@ -477,6 +477,145 @@ class JaxChecker:
         return out
 
     # -- checkpoint / resume (TLC's states/ metadir + -recover) ------------
+    #
+    # Two formats:
+    #
+    # * **delta log** (the default; ``checkpoint_dir`` is a directory of
+    #   ``delta_####.npz`` files): each BFS level appends only its
+    #   (parent, slot) payloads and new canonical fingerprints —
+    #   ~14 B/state, all of which the level already fetched to the host
+    #   for trace reconstruction (plus the fps).  Resume REPLAYS the
+    #   materialize pass level by level from Init — minutes of device
+    #   compute instead of a multi-GB frontier fetch.  The monolith
+    #   format's full-frontier ``device_get`` (~2.7 GB at a 6M-state
+    #   frontier) repeatedly crashed the tunneled device worker.
+    #
+    # * **monolith** (``latest.npz``, back-compat): full frontier +
+    #   visited store in one file; O(1) resume but O(frontier) fetch.
+
+    def _save_delta(self, ckdir, depth, pidx_np, slot_np, fps_np,
+                    level_mult, n_new):
+        os.makedirs(ckdir, exist_ok=True)
+        tmp = os.path.join(ckdir, f".tmp_delta_{depth:04d}.npz")
+        np.savez(
+            tmp,
+            pidx=pidx_np.astype(np.uint32),
+            slot=slot_np.astype(np.uint16),
+            fps=fps_np.astype(np.uint64),
+            mult=level_mult.astype(np.int64),
+            meta=np.asarray([depth, n_new], np.int64),
+        )
+        os.replace(tmp, os.path.join(ckdir, f"delta_{depth:04d}.npz"))
+
+    def _materialize_payload_slices(self, frontier, new_payload, n_new):
+        """Run _mat_slice over every survivor slice; returns the parts."""
+        sl = min(4 * self.chunk, new_payload.shape[0])
+        child_parts, bad_ds, ovf_ds = [], [], []
+        n_slices = -(-n_new // sl)
+        for si in range(n_slices):
+            take = min(sl, n_new - si * sl)
+            pay_slice = jax.lax.dynamic_slice_in_dim(new_payload, si * sl, sl)
+            ch_f, bad_d, ovf_d = self._mat_slice(
+                frontier, pay_slice, jnp.asarray(take, I64)
+            )
+            child_parts.append(ch_f)
+            bad_ds.append(bad_d)
+            ovf_ds.append(ovf_d)
+            if si % 32 == 31:
+                jax.device_get(bad_d)  # bound the dispatch queue
+        return child_parts, bad_ds, ovf_ds, n_slices, sl
+
+    def _resume_from_deltas(self, ckdir):
+        """Rebuild the run state by replaying the delta log.
+
+        The replay starts from Init, or from a ``base.npz`` monolith
+        snapshot if one sits in the directory (written when a run that
+        itself resumed from a monolith starts appending deltas)."""
+        import glob
+
+        files = sorted(glob.glob(os.path.join(ckdir, "delta_*.npz")))
+        base_path = os.path.join(ckdir, "base.npz")
+        if not files and not os.path.exists(base_path):
+            raise ValueError(f"no delta_*.npz checkpoints under {ckdir}")
+        cfg, K = self.cfg, self.K
+        if os.path.exists(base_path):
+            ck = self._load_checkpoint(base_path)
+            frontier, n_f = ck["frontier"], ck["n_f"]
+            visited_base = ck["visited"]
+            fps_parts = []
+            trace_levels = ck["trace_levels"]
+            level_sizes = list(ck["level_sizes"])
+            mult_per_slot = np.asarray(ck["mult_per_slot"])
+            depth = ck["depth"]
+            base_distinct = ck["distinct"]
+        else:
+            st0 = init_batch(cfg, 1)
+            fv0, _ff0, _ms = self.fpr.state_fingerprints(st0)
+            frontier, _ovf = jax.jit(self._deflate)(st0)
+            frontier = jax.tree.map(
+                lambda x: _pad_axis0(x, self.chunk), frontier
+            )
+            n_f = 1
+            visited_base = None
+            fps_parts = [np.asarray(fv0.astype(U64))]
+            trace_levels, level_sizes = [], [1]
+            mult_per_slot = np.zeros(K, np.int64)
+            depth = 0
+            base_distinct = 1
+        for f in files:
+            z = np.load(f)
+            d, n_new = (int(x) for x in z["meta"])
+            if d != depth + 1:
+                raise ValueError(
+                    f"delta log gap: expected level {depth + 1}, found "
+                    f"level {d} ({f})"
+                )
+            pidx = z["pidx"].astype(np.int64)
+            slot = z["slot"].astype(np.int64)
+            payload_np = pidx * K + slot
+            cap = max(_pow2(n_new), 4 * self.chunk)
+            new_payload = _pad_axis0(jnp.asarray(payload_np, I64), cap)
+            parts, _bads, ovfs, _ns, _sl = self._materialize_payload_slices(
+                frontier, new_payload, n_new
+            )
+            if any(bool(np.asarray(o)) for o in ovfs):
+                raise RuntimeError(
+                    f"cap_m overflow replaying level {d}; rerun with a "
+                    f"larger cap_m"
+                )
+            cap_f = max(_pow2(n_new), self.chunk)
+            frontier = None  # drop the parent copy before the concat
+            frontier = jax.tree.map(
+                lambda *xs: _pad_axis0(jnp.concatenate(xs), cap_f), *parts
+            )
+            n_f = n_new
+            fps_parts.append(z["fps"])
+            trace_levels.append((pidx, slot))
+            level_sizes.append(n_new)
+            mult_per_slot = mult_per_slot + z["mult"]
+            depth = d
+        distinct = int(sum(level_sizes))
+        new_fp_count = int(sum(len(p) for p in fps_parts))
+        parts_dev = [jnp.asarray(np.concatenate(fps_parts), U64)] if fps_parts else []
+        if visited_base is not None:
+            parts_dev.insert(0, visited_base)
+            pad_to = _cap4(distinct + 1) - new_fp_count - visited_base.shape[0]
+        else:
+            pad_to = _cap4(distinct + 1) - new_fp_count
+        if pad_to > 0:
+            parts_dev.append(jnp.full((pad_to,), SENT, U64))
+        visited = jnp.sort(jnp.concatenate(parts_dev))[: _cap4(distinct + 1)]
+        return dict(
+            frontier=frontier,
+            visited=visited,
+            n_f=n_f,
+            distinct=distinct,
+            generated=int(mult_per_slot.sum()),
+            depth=depth,
+            level_sizes=level_sizes,
+            trace_levels=trace_levels,
+            mult_per_slot=mult_per_slot,
+        )
 
     def _save_checkpoint(self, path, frontier, visited, n_f, distinct,
                          generated, depth, level_sizes, trace_levels,
@@ -548,6 +687,7 @@ class JaxChecker:
         overflow_g = jnp.zeros((), bool)
         G = self.G  # chunks per visited-filter group
         n_chunks = -(-max(n_f, 1) // self.chunk)
+        synced = 0  # chunks dispatched since the last queue drain
         # group-filtering only pays (and only sizes correctly) once most
         # candidates are revisits — at small frontiers the level-wide sort
         # is tiny and new/parent ratios (up to ~2.5) would overflow cap_g.
@@ -590,6 +730,14 @@ class JaxChecker:
             overflow = overflow | ovf
             if grouping and len(cvs) == G:
                 overflow_g = overflow_g | flush_group()
+            # bound the async dispatch queue: hundreds of queued chunk
+            # programs (each holding its input slice + outputs) crash the
+            # tunneled device worker on multi-million-state levels; a
+            # scalar fetch every few groups drains the queue at ~no cost
+            synced += 1
+            if synced >= 2 * G:
+                jax.device_get(abort_at)
+                synced = 0
         if grouping and cvs:
             overflow_g = overflow_g | flush_group()
         if grouping:
@@ -635,8 +783,42 @@ class JaxChecker:
                 "resumed run would see its own pre-crash inserts as "
                 "already-visited and report a truncated clean sweep"
             )
+        if checkpoint_dir and checkpoint_every:
+            import glob as _glob
+
+            stale = _glob.glob(os.path.join(checkpoint_dir, "delta_*.npz"))
+            if resume_from is None and stale:
+                raise ValueError(
+                    f"{checkpoint_dir} holds {len(stale)} delta checkpoints "
+                    "from a previous run; a fresh run would interleave two "
+                    "runs' logs into one (silently wrong) replay chain — "
+                    "resume with --recover or clear the directory"
+                )
+            if (
+                resume_from is not None
+                and not os.path.isdir(resume_from)
+                and os.path.abspath(resume_from)
+                != os.path.abspath(os.path.join(checkpoint_dir, "base.npz"))
+            ):
+                # resuming from a monolith while appending deltas: anchor
+                # the delta chain by copying the monolith in as the base
+                if stale:
+                    raise ValueError(
+                        f"{checkpoint_dir} already holds delta checkpoints; "
+                        "resume from the directory itself instead of a "
+                        "monolith file"
+                    )
+                import shutil
+
+                os.makedirs(checkpoint_dir, exist_ok=True)
+                shutil.copyfile(
+                    resume_from, os.path.join(checkpoint_dir, "base.npz")
+                )
         if resume_from is not None:
-            ck = self._load_checkpoint(resume_from)
+            if os.path.isdir(resume_from):
+                ck = self._resume_from_deltas(resume_from)
+            else:
+                ck = self._load_checkpoint(resume_from)
             frontier, visited = ck["frontier"], ck["visited"]
             n_f, distinct, generated = ck["n_f"], ck["distinct"], ck["generated"]
             depth, level_sizes, trace_levels = (
@@ -729,19 +911,9 @@ class JaxChecker:
             # --- materialize the survivors (device-resident) ------------
             # slice width must not exceed the payload capacity (a custom
             # cap_x < 4*chunk shrinks the dedup output below 4*chunk)
-            sl = min(4 * self.chunk, new_payload.shape[0])
-            child_parts, bad_ds, ovf_ds = [], [], []
-            n_slices = -(-n_new // sl)
-            for si in range(n_slices):
-                off = si * sl
-                take = min(sl, n_new - off)
-                pay_slice = jax.lax.dynamic_slice_in_dim(new_payload, off, sl)
-                ch_f, bad_d, ovf_d = self._mat_slice(
-                    frontier, pay_slice, jnp.asarray(take, I64)
-                )
-                child_parts.append(ch_f)
-                bad_ds.append(bad_d)
-                ovf_ds.append(ovf_d)
+            child_parts, bad_ds, ovf_ds, n_slices, sl = (
+                self._materialize_payload_slices(frontier, new_payload, n_new)
+            )
             # one fused fetch of the per-slice scalars + the trace spill
             pidx32 = (new_payload[: n_slices * sl] // K).astype(U32C)
             slot16 = (new_payload[: n_slices * sl] % K).astype(jnp.uint16)
@@ -763,8 +935,12 @@ class JaxChecker:
                     break
             # pow2-quantized capacity: _mat_slice and the expand slicing
             # take the frontier as a traced input, so its shape must cycle
-            # through O(log) values per run, not one per level
+            # through O(log) values per run, not one per level.  Drop the
+            # parent frontier first — at multi-million-state levels the
+            # old frontier, the child parts and the concatenated result
+            # would otherwise coexist (~3 copies of GB-scale buffers)
             cap_f = max(_pow2(n_new), self.chunk)
+            frontier = None
             frontier = jax.tree.map(
                 lambda *xs: _pad_axis0(jnp.concatenate(xs), cap_f),
                 *child_parts,
@@ -811,13 +987,15 @@ class JaxChecker:
                 )
             # checkpoint only invariant-clean levels: a resumed run never
             # re-checks its loaded frontier, so saving before the check
-            # could hide a violation behind a crash+resume
-            if checkpoint_dir and checkpoint_every and depth % checkpoint_every == 0:
-                os.makedirs(checkpoint_dir, exist_ok=True)
-                self._save_checkpoint(
-                    os.path.join(checkpoint_dir, "latest.npz"), frontier,
-                    visited, n_f, distinct, generated, depth, level_sizes,
-                    trace_levels, mult_per_slot,
+            # could hide a violation behind a crash+resume.  Delta-log
+            # format: every level appends its (parent, slot, fps) record
+            # (the replay chain needs every level, so checkpoint_every
+            # only gates whether checkpointing happens at all).
+            if checkpoint_dir and checkpoint_every:
+                fps_np = np.asarray(new_fps[:n_new]).astype(np.uint64)
+                self._save_delta(
+                    checkpoint_dir, depth, pidx_np, slot_np, fps_np,
+                    level_mult, n_new,
                 )
 
         return CheckResult(
