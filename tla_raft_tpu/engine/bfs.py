@@ -302,6 +302,8 @@ class JaxChecker:
         # overflow grows cap_g like cap_x)
         self.G = 16
         self.cap_g = self.G * self.cap_x // 2
+        # chunks dispatched between queue-draining scalar fetches
+        self.sync_every = 1
         self.progress = progress
         # optional native external-memory visited store (native/fpstore.cpp);
         # when set, the device keeps no visited table at all — the level's
@@ -521,7 +523,7 @@ class JaxChecker:
             child_parts.append(ch_f)
             bad_ds.append(bad_d)
             ovf_ds.append(ovf_d)
-            if si % 32 == 31:
+            if si % 4 == 3:
                 jax.device_get(bad_d)  # bound the dispatch queue
         return child_parts, bad_ds, ovf_ds, n_slices, sl
 
@@ -547,7 +549,6 @@ class JaxChecker:
             level_sizes = list(ck["level_sizes"])
             mult_per_slot = np.asarray(ck["mult_per_slot"])
             depth = ck["depth"]
-            base_distinct = ck["distinct"]
         else:
             st0 = init_batch(cfg, 1)
             fv0, _ff0, _ms = self.fpr.state_fingerprints(st0)
@@ -561,7 +562,6 @@ class JaxChecker:
             trace_levels, level_sizes = [], [1]
             mult_per_slot = np.zeros(K, np.int64)
             depth = 0
-            base_distinct = 1
         for f in files:
             z = np.load(f)
             d, n_new = (int(x) for x in z["meta"])
@@ -730,12 +730,16 @@ class JaxChecker:
             overflow = overflow | ovf
             if grouping and len(cvs) == G:
                 overflow_g = overflow_g | flush_group()
-            # bound the async dispatch queue: hundreds of queued chunk
-            # programs (each holding its input slice + outputs) crash the
-            # tunneled device worker on multi-million-state levels; a
-            # scalar fetch every few groups drains the queue at ~no cost
+            # bound the async dispatch queue: queued chunk programs (each
+            # holding its input slices and outputs) crash the tunneled
+            # device worker on multi-million-state levels — even a
+            # 32-chunk window died; the per-chunk scalar drain is the
+            # profiler-proven configuration and costs ~10 ms against a
+            # ~400 ms chunk (the round-1 regression was per-chunk
+            # fetches of whole result arrays at 256-state chunks, not
+            # the drain itself)
             synced += 1
-            if synced >= 2 * G:
+            if synced >= self.sync_every:
                 jax.device_get(abort_at)
                 synced = 0
         if grouping and cvs:
@@ -787,12 +791,25 @@ class JaxChecker:
             import glob as _glob
 
             stale = _glob.glob(os.path.join(checkpoint_dir, "delta_*.npz"))
-            if resume_from is None and stale:
+            has_base = os.path.exists(os.path.join(checkpoint_dir, "base.npz"))
+            if resume_from is None and (stale or has_base):
                 raise ValueError(
-                    f"{checkpoint_dir} holds {len(stale)} delta checkpoints "
-                    "from a previous run; a fresh run would interleave two "
-                    "runs' logs into one (silently wrong) replay chain — "
-                    "resume with --recover or clear the directory"
+                    f"{checkpoint_dir} holds checkpoints from a previous "
+                    "run; a fresh run would interleave two runs' logs into "
+                    "one (silently wrong) replay chain — resume with "
+                    "--recover or clear the directory"
+                )
+            if (
+                resume_from is not None
+                and os.path.isdir(resume_from)
+                and os.path.abspath(resume_from) != os.path.abspath(checkpoint_dir)
+                and (stale or has_base)
+            ):
+                raise ValueError(
+                    f"resuming from {resume_from} but {checkpoint_dir} "
+                    "already holds another run's checkpoints — the two "
+                    "logs would interleave; clear it or checkpoint into "
+                    "the resumed directory itself"
                 )
             if (
                 resume_from is not None
