@@ -70,12 +70,14 @@ def main():
         overrides["max_election"] = int(os.environ["BENCH_MAX_ELECTION"])
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
-    # Default: a depth-16 prefix (~657k distinct states).  The full sweep
-    # of Raft.cfg runs for hours on a cold compile cache (remote compiles
-    # on the tunneled device are minutes per power-of-two shape) — the
-    # full-space golden record lives in BASELINE.md and gates any run
-    # that does reach the fixpoint (BENCH_MAX_DEPTH=0 requests that).
-    md_env = os.environ.get("BENCH_MAX_DEPTH", "16")
+    # Default: a depth-18 prefix (~2M distinct states — deep enough that
+    # per-level fixed costs amortize into the steady-state rate).  The
+    # full sweep of Raft.cfg runs for hours on a cold compile cache
+    # (remote compiles on the tunneled device are minutes per
+    # power-of-two shape) — the full-space golden record lives in
+    # BASELINE.md and gates any run that does reach the fixpoint
+    # (BENCH_MAX_DEPTH=0 requests that).
+    md_env = os.environ.get("BENCH_MAX_DEPTH", "18")
     max_depth = int(md_env) or None
     chunk = int(os.environ.get("BENCH_CHUNK", "8192"))
     gold_depth = int(os.environ.get("BENCH_GOLD_DEPTH", "12"))
