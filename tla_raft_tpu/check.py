@@ -232,15 +232,19 @@ def main(argv=None) -> int:
             if args.mesh:
                 p.error("--fpstore-dir is not supported with --mesh yet "
                         "(the distributed store is device-sharded)")
-            if args.checkpoint_dir or args.recover:
-                # the .npz checkpoint does not snapshot the on-disk store,
-                # so a resumed run would see its own pre-crash inserts as
-                # already-visited and report a truncated clean sweep
-                p.error("--fpstore-dir cannot be combined with "
-                        "--checkpoint-dir/--recover yet")
+            if (args.recover and os.path.exists(args.recover)
+                    and not os.path.isdir(args.recover)):
+                # delta-log resume rebuilds the store from the logged
+                # fingerprints; a monolith's visited snapshot can't
+                p.error("--fpstore-dir resumes from a delta-log "
+                        "directory only, not a monolith .npz")
             from .native import HostFPStore
 
             host_store = HostFPStore(args.fpstore_dir)
+            if not args.recover:
+                # sweep run files orphaned by a crashed earlier process
+                # (never loaded, but they waste disk and shadow names)
+                host_store.clear()
             print(f"Native FP store: {args.fpstore_dir}", file=out)
 
         if args.mesh:

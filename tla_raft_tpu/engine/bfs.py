@@ -539,6 +539,21 @@ class JaxChecker:
         base_path = os.path.join(ckdir, "base.npz")
         if not files and not os.path.exists(base_path):
             raise ValueError(f"no delta_*.npz checkpoints under {ckdir}")
+        if self.host_store is not None and os.path.exists(base_path):
+            raise ValueError(
+                "cannot resume a host-store run from a delta log anchored "
+                "on a base.npz monolith: the base's visited snapshot "
+                "belongs to the device-store path"
+            )
+        if self.host_store is not None:
+            # rebuild the external store from the log as the replay walks
+            # it (level-at-a-time inserts keep the store's spill budget in
+            # force — the whole point of the external tier is a visited
+            # set bigger than host RAM).  clear() first: the store may
+            # still hold pre-crash inserts, including a partially-
+            # completed level that never reached the log, and those would
+            # silently mark reachable states as already-visited.
+            self.host_store.clear()
         cfg, K = self.cfg, self.K
         if os.path.exists(base_path):
             ck = self._load_checkpoint(base_path)
@@ -558,7 +573,12 @@ class JaxChecker:
             )
             n_f = 1
             visited_base = None
-            fps_parts = [np.asarray(fv0.astype(U64))]
+            init_fps = np.asarray(fv0.astype(U64))
+            if self.host_store is not None:
+                self.host_store.insert(init_fps)
+                fps_parts = []
+            else:
+                fps_parts = [init_fps]
             trace_levels, level_sizes = [], [1]
             mult_per_slot = np.zeros(K, np.int64)
             depth = 0
@@ -589,22 +609,30 @@ class JaxChecker:
                 lambda *xs: _pad_axis0(jnp.concatenate(xs), cap_f), *parts
             )
             n_f = n_new
-            fps_parts.append(z["fps"])
+            if self.host_store is not None:
+                self.host_store.insert(z["fps"])
+            else:
+                fps_parts.append(z["fps"])
             trace_levels.append((pidx, slot))
             level_sizes.append(n_new)
             mult_per_slot = mult_per_slot + z["mult"]
             depth = d
         distinct = int(sum(level_sizes))
-        new_fp_count = int(sum(len(p) for p in fps_parts))
-        parts_dev = [jnp.asarray(np.concatenate(fps_parts), U64)] if fps_parts else []
-        if visited_base is not None:
-            parts_dev.insert(0, visited_base)
-            pad_to = _cap4(distinct + 1) - new_fp_count - visited_base.shape[0]
+        if self.host_store is not None:
+            visited = jnp.full((64,), SENT, U64)
         else:
-            pad_to = _cap4(distinct + 1) - new_fp_count
-        if pad_to > 0:
-            parts_dev.append(jnp.full((pad_to,), SENT, U64))
-        visited = jnp.sort(jnp.concatenate(parts_dev))[: _cap4(distinct + 1)]
+            new_fp_count = int(sum(len(p) for p in fps_parts))
+            parts_dev = (
+                [jnp.asarray(np.concatenate(fps_parts), U64)] if fps_parts else []
+            )
+            if visited_base is not None:
+                parts_dev.insert(0, visited_base)
+                pad_to = _cap4(distinct + 1) - new_fp_count - visited_base.shape[0]
+            else:
+                pad_to = _cap4(distinct + 1) - new_fp_count
+            if pad_to > 0:
+                parts_dev.append(jnp.full((pad_to,), SENT, U64))
+            visited = jnp.sort(jnp.concatenate(parts_dev))[: _cap4(distinct + 1)]
         return dict(
             frontier=frontier,
             visited=visited,
@@ -780,12 +808,22 @@ class JaxChecker:
         K = self.K
         t0 = time.monotonic()
 
-        if self.host_store is not None and (resume_from or checkpoint_dir):
+        if self.host_store is not None and (
+            resume_from is not None
+            and os.path.exists(resume_from)
+            and not os.path.isdir(resume_from)
+        ):
+            # (a nonexistent path falls through to the normal "no
+            # checkpoints under ..." / FileNotFoundError reporting)
+            # Delta-log checkpoints compose with the host store: resume
+            # replays the log and REBUILDS the store from the logged
+            # fingerprints (discarding any pre-crash partial inserts).  A
+            # monolith .npz snapshot can't — its visited array belongs to
+            # the device-store path.
             raise ValueError(
-                "host_store cannot be combined with checkpoint/resume: the "
-                ".npz snapshot does not capture the on-disk store, so a "
-                "resumed run would see its own pre-crash inserts as "
-                "already-visited and report a truncated clean sweep"
+                "host_store supports delta-log checkpoints only: resume "
+                "from the checkpoint directory, not a monolith .npz "
+                "(the monolith's visited snapshot bypasses the store)"
             )
         if checkpoint_dir and checkpoint_every:
             import glob as _glob
@@ -914,10 +952,12 @@ class JaxChecker:
             mult_per_slot = mult_per_slot + level_mult
             generated += int(level_mult.sum())
 
+            fps_host = None  # host-filtered level fps (delta-log record)
             if self.host_store is not None and n_new:
                 fps_np = np.asarray(new_fps[:n_new])
                 is_new = self.host_store.insert(fps_np)
                 filtered = np.asarray(new_payload[:n_new])[is_new]
+                fps_host = fps_np[is_new]
                 n_new = len(filtered)
                 new_payload = _pad_axis0(
                     jnp.asarray(filtered), max(_pow2(n_new), 4 * self.chunk)
@@ -1009,7 +1049,13 @@ class JaxChecker:
             # (the replay chain needs every level, so checkpoint_every
             # only gates whether checkpointing happens at all).
             if checkpoint_dir and checkpoint_every:
-                fps_np = np.asarray(new_fps[:n_new]).astype(np.uint64)
+                # with a host store the device fps are pre-filter — the
+                # log must hold exactly the level's NEW fingerprints
+                fps_np = (
+                    fps_host
+                    if fps_host is not None
+                    else np.asarray(new_fps[:n_new])
+                ).astype(np.uint64)
                 self._save_delta(
                     checkpoint_dir, depth, pidx_np, slot_np, fps_np,
                     level_mult, n_new,

@@ -73,6 +73,8 @@ class HostFPStore:
 
     def __init__(self, dirpath: str, mem_budget_entries: int = 0):
         os.makedirs(dirpath, exist_ok=True)
+        self._dir = dirpath
+        self._budget = mem_budget_entries
         self._lib = _load()
         self._h = self._lib.fpstore_open(
             dirpath.encode(), ctypes.c_uint64(mem_budget_entries)
@@ -114,6 +116,25 @@ class HostFPStore:
     def compact(self) -> None:
         if self._lib.fpstore_compact(self._h) != 0:
             raise IOError("fpstore compact failed")
+
+    def clear(self) -> None:
+        """Empty the store in place (delta-log resume rebuilds it).
+
+        Reopens a fresh native handle (close unlinks this handle's run
+        files) and sweeps any orphaned ``run_*.fp`` left by a crashed
+        process — those were never loaded, but they waste disk and their
+        names will be reused.
+        """
+        import glob
+
+        self.close()
+        for f in glob.glob(os.path.join(self._dir, "run_*.fp")):
+            os.unlink(f)
+        self._h = self._lib.fpstore_open(
+            self._dir.encode(), ctypes.c_uint64(self._budget)
+        )
+        if not self._h:
+            raise RuntimeError("fpstore_open failed")
 
     def close(self) -> None:
         if self._h:
