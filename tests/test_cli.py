@@ -1,0 +1,89 @@
+"""CLI smoke tests: the L4 launcher surface (check.py), driven the way a
+user drives it (``python -m tla_raft_tpu.check ...`` — the ``-backend=jax``
+leg of myrun.sh, /root/reference/myrun.sh:3).
+
+These run in-process via ``main(argv)`` (a subprocess would re-pay jax
+startup per case) on tiny configs, and assert on the TLC-shaped output
+contract: the "Model checking completed" / "N states generated, M distinct"
+lines, the raft.log tee, the --json summary, and the exit-code convention
+(0 = clean sweep, 1 = violation found, 2 = usage error).
+"""
+
+import json
+
+import pytest
+
+from tla_raft_tpu.check import main
+
+TINY = ["--servers", "2", "--vals", "1", "--max-election", "1",
+        "--max-restart", "1"]
+
+
+def run_cli(tmp_path, *args):
+    log = tmp_path / "raft.log"
+    out = tmp_path / "stdout.txt"
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(list(args) + ["--log", str(log)])
+    out.write_text(buf.getvalue())
+    return rc, buf.getvalue(), log
+
+
+def test_clean_sweep_exit_zero_and_log_tee(tmp_path):
+    rc, out, log = run_cli(tmp_path, *TINY, "--backend", "oracle")
+    assert rc == 0
+    assert "Model checking completed. No error has been found." in out
+    assert "97 states generated, 50 distinct states found, depth 12." in out
+    assert "fingerprint collision" in out
+    # the tee contract: everything printed also lands in the log file
+    assert log.read_text() == out
+
+
+def test_jax_backend_matches_oracle_counts(tmp_path):
+    rc, out, _ = run_cli(tmp_path, *TINY, "--chunk", "64")
+    assert rc == 0
+    assert "97 states generated, 50 distinct states found, depth 12." in out
+
+
+def test_violation_exit_one_with_trace(tmp_path):
+    # ~RaftCanCommt is a reachability probe: checking its negation MUST
+    # find a violation with a replayable trace (SURVEY.md §4.3)
+    rc, out, _ = run_cli(
+        tmp_path, "--servers", "3", "--vals", "1", "--max-election", "1",
+        "--max-restart", "0", "--backend", "oracle",
+        "--invariant", "~RaftCanCommt",
+    )
+    assert rc == 1
+    assert "Invariant" in out and "violated" in out
+    assert "STATE 1" in out  # TLC-shaped numbered trace from Init
+
+
+def test_json_summary_line(tmp_path):
+    rc, out, _ = run_cli(tmp_path, *TINY, "--backend", "oracle", "--json")
+    assert rc == 0
+    last = [l for l in out.strip().splitlines() if l.startswith("{")][-1]
+    summary = json.loads(last)
+    assert summary["distinct"] == 50
+    assert summary["generated"] == 97
+    assert summary["ok"] is True
+
+
+def test_usage_error_exit_two(tmp_path):
+    with pytest.raises(SystemExit) as ei:
+        main(["--backend", "nonesuch"])
+    assert ei.value.code == 2
+
+
+def test_mutation_is_caught_with_counterexample(tmp_path):
+    # the planted FindMedian ÷2 bug (Raft.tla:65-66) must produce a
+    # genuine Inv violation when compiled in (SURVEY.md §4.4)
+    rc, out, _ = run_cli(
+        tmp_path, "--servers", "3", "--vals", "1", "--max-election", "2",
+        "--max-restart", "0", "--backend", "oracle",
+        "--mutate", "median-bug",
+    )
+    assert rc == 1
+    assert "violated" in out
