@@ -131,3 +131,62 @@ def test_host_store_delta_log_records_filtered_fps(tmp_path, built):
     assert (resumed.ok, resumed.distinct, resumed.depth, resumed.level_sizes) == (
         want.ok, want.distinct, want.depth, want.level_sizes,
     )
+
+
+def test_host_store_resume_from_monolith_anchored_delta_log(tmp_path, built):
+    """A delta log anchored on a device-store base.npz monolith can be
+    resumed with a host store: the base's visited array IS the
+    fingerprint set, so it seeds the cleared store (the two dedup tiers
+    hold the same content, only the location differs)."""
+    import numpy as np
+
+    from tla_raft_tpu.config import RaftConfig
+    from tla_raft_tpu.engine import JaxChecker
+    from tla_raft_tpu.oracle import OracleChecker
+
+    cfg = RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1)
+    want = OracleChecker(cfg).run()
+
+    # build a monolith-anchored delta dir: a device-store run to depth 3,
+    # snapshotted as base.npz, then two delta levels on top
+    ckdir = tmp_path / "states"
+    ckdir.mkdir()
+    chk = JaxChecker(cfg, chunk=64)
+    chk.run(max_depth=3, checkpoint_dir=str(ckdir), checkpoint_every=1)
+    ck = chk._resume_from_deltas(str(ckdir))
+    chk._save_checkpoint(
+        str(ckdir / "base.npz"), ck["frontier"], ck["visited"], ck["n_f"],
+        ck["distinct"], ck["generated"], ck["depth"], ck["level_sizes"],
+        ck["trace_levels"], ck["mult_per_slot"],
+    )
+    for f in ckdir.glob("delta_*.npz"):
+        f.unlink()
+    chk2 = JaxChecker(cfg, chunk=64)
+    chk2.run(
+        max_depth=5, checkpoint_dir=str(ckdir), checkpoint_every=1,
+        resume_from=str(ckdir),
+    )
+    assert (ckdir / "base.npz").exists()
+    assert len(list(ckdir.glob("delta_*.npz"))) == 2  # levels 4, 5
+
+    # resume THAT with a host store (plus poison to prove the clear)
+    store = HostFPStore(str(tmp_path / "fp"), mem_budget_entries=64)
+    store.insert(np.arange(7_000, 8_000, dtype=np.uint64))
+    got = JaxChecker(cfg, chunk=64, host_store=store).run(
+        resume_from=str(ckdir)
+    )
+    assert (got.ok, got.distinct, got.generated, got.depth, got.level_sizes) == (
+        want.ok, want.distinct, want.generated, want.depth, want.level_sizes,
+    )
+    assert len(store) == want.distinct
+
+    # and a DIRECT monolith-file resume (no delta replay) seeds the
+    # store the same way
+    store3 = HostFPStore(str(tmp_path / "fp3"), mem_budget_entries=64)
+    got3 = JaxChecker(cfg, chunk=64, host_store=store3).run(
+        resume_from=str(ckdir / "base.npz")
+    )
+    assert (got3.ok, got3.distinct, got3.depth, got3.level_sizes) == (
+        want.ok, want.distinct, want.depth, want.level_sizes,
+    )
+    assert len(store3) == want.distinct

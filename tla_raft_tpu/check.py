@@ -232,12 +232,6 @@ def main(argv=None) -> int:
             if args.mesh:
                 p.error("--fpstore-dir is not supported with --mesh yet "
                         "(the distributed store is device-sharded)")
-            if (args.recover and os.path.exists(args.recover)
-                    and not os.path.isdir(args.recover)):
-                # delta-log resume rebuilds the store from the logged
-                # fingerprints; a monolith's visited snapshot can't
-                p.error("--fpstore-dir resumes from a delta-log "
-                        "directory only, not a monolith .npz")
             from .native import HostFPStore
 
             host_store = HostFPStore(args.fpstore_dir)

@@ -539,12 +539,6 @@ class JaxChecker:
         base_path = os.path.join(ckdir, "base.npz")
         if not files and not os.path.exists(base_path):
             raise ValueError(f"no delta_*.npz checkpoints under {ckdir}")
-        if self.host_store is not None and os.path.exists(base_path):
-            raise ValueError(
-                "cannot resume a host-store run from a delta log anchored "
-                "on a base.npz monolith: the base's visited snapshot "
-                "belongs to the device-store path"
-            )
         if self.host_store is not None:
             # rebuild the external store from the log as the replay walks
             # it (level-at-a-time inserts keep the store's spill budget in
@@ -556,9 +550,19 @@ class JaxChecker:
             self.host_store.clear()
         cfg, K = self.cfg, self.K
         if os.path.exists(base_path):
-            ck = self._load_checkpoint(base_path)
+            ck = self._load_checkpoint(
+                base_path, device_visited=self.host_store is None
+            )
             frontier, n_f = ck["frontier"], ck["n_f"]
             visited_base = ck["visited"]
+            if self.host_store is not None:
+                # a device-store monolith seeds the external store: its
+                # visited array IS the fingerprint set (sorted, SENT-
+                # padded).  The base may be a checkpoint of a device-store
+                # run — the two tiers' contents are interchangeable; only
+                # their location differs.
+                self._seed_host_store(visited_base)
+                visited_base = None
             fps_parts = []
             trace_levels = ck["trace_levels"]
             level_sizes = list(ck["level_sizes"])
@@ -668,8 +672,21 @@ class JaxChecker:
         save(tmp, **payload)
         os.replace(tmp, path)
 
+    def _seed_host_store(self, visited):
+        """Insert a visited array's real (non-SENT) fps into the store.
+
+        Sliced inserts keep the store's spill budget in force; `visited`
+        should be host-side (numpy) — pass ``device_visited=False`` to
+        ``_load_checkpoint`` so multi-GB snapshots never round-trip
+        through the device on a host-store resume.
+        """
+        vb = np.asarray(visited)
+        vb = vb[vb != np.uint64(0xFFFFFFFFFFFFFFFF)]
+        for i in range(0, len(vb), 1 << 22):
+            self.host_store.insert(vb[i : i + (1 << 22)])
+
     @staticmethod
-    def _load_checkpoint(path):
+    def _load_checkpoint(path, device_visited=True):
         z = np.load(path)
         fields = {k[3:] for k in z.files if k.startswith("st_")}
         if fields != set(Frontier._fields):
@@ -688,7 +705,10 @@ class JaxChecker:
         return dict(
             frontier=frontier,
             mult_per_slot=np.asarray(z["mult_per_slot"]),
-            visited=jnp.asarray(z["visited"]),
+            # host-store resumes read the (potentially multi-GB) visited
+            # snapshot host-side only — it seeds the external store and
+            # must not ride along on the device through the replay
+            visited=jnp.asarray(z["visited"]) if device_visited else z["visited"],
             n_f=n_f,
             distinct=distinct,
             generated=generated,
@@ -808,23 +828,6 @@ class JaxChecker:
         K = self.K
         t0 = time.monotonic()
 
-        if self.host_store is not None and (
-            resume_from is not None
-            and os.path.exists(resume_from)
-            and not os.path.isdir(resume_from)
-        ):
-            # (a nonexistent path falls through to the normal "no
-            # checkpoints under ..." / FileNotFoundError reporting)
-            # Delta-log checkpoints compose with the host store: resume
-            # replays the log and REBUILDS the store from the logged
-            # fingerprints (discarding any pre-crash partial inserts).  A
-            # monolith .npz snapshot can't — its visited array belongs to
-            # the device-store path.
-            raise ValueError(
-                "host_store supports delta-log checkpoints only: resume "
-                "from the checkpoint directory, not a monolith .npz "
-                "(the monolith's visited snapshot bypasses the store)"
-            )
         if checkpoint_dir and checkpoint_every:
             import glob as _glob
 
@@ -873,7 +876,17 @@ class JaxChecker:
             if os.path.isdir(resume_from):
                 ck = self._resume_from_deltas(resume_from)
             else:
-                ck = self._load_checkpoint(resume_from)
+                ck = self._load_checkpoint(
+                    resume_from, device_visited=self.host_store is None
+                )
+                if self.host_store is not None:
+                    # a monolith of a device-store run resumes onto the
+                    # external tier: its visited array IS the fingerprint
+                    # set, so it seeds the cleared store (same move as the
+                    # base.npz path in _resume_from_deltas)
+                    self.host_store.clear()
+                    self._seed_host_store(ck.pop("visited"))
+                    ck["visited"] = jnp.full((64,), SENT, U64)
             frontier, visited = ck["frontier"], ck["visited"]
             n_f, distinct, generated = ck["n_f"], ck["distinct"], ck["generated"]
             depth, level_sizes, trace_levels = (
