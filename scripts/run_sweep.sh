@@ -5,6 +5,9 @@
 # process crashed or restarted", remote-compile connection drops); the
 # checker checkpoints every level, so this wrapper simply resumes until
 # the run exits cleanly.  Usage: scripts/run_sweep.sh [chunk] [canon]
+# Set FPSTORE=<dir> to run the visited set on the external-memory C++
+# store instead of the device (deep levels: no device-resident
+# fingerprint table or big-table sort/searchsorted programs at all).
 
 set -u
 cd "$(dirname "$0")/.."
@@ -27,11 +30,15 @@ while true; do
     RECOVER=(--recover "$CKDIR")
   fi
   echo "run_sweep: attempt $TRIES (recover: ${RECOVER[*]:-none})" >&2
+  FPFLAGS=()
+  if [ -n "${FPSTORE:-}" ]; then
+    FPFLAGS=(--fpstore-dir "$FPSTORE")
+  fi
   python -m tla_raft_tpu.check \
     --config /root/reference/Raft.cfg \
     --chunk "$CHUNK" --canon "$CANON" \
     --checkpoint-dir "$CKDIR" --checkpoint-every 1 \
-    "${RECOVER[@]}" --json --log raft_sweep.log
+    "${FPFLAGS[@]}" "${RECOVER[@]}" --json --log raft_sweep.log
   RC=$?
   if [ "$RC" -eq 0 ]; then
     echo "run_sweep: clean completion" >&2
