@@ -190,3 +190,30 @@ def test_host_store_resume_from_monolith_anchored_delta_log(tmp_path, built):
         want.ok, want.distinct, want.depth, want.level_sizes,
     )
     assert len(store3) == want.distinct
+
+
+def test_host_store_many_chunk_level_parity(tmp_path, built):
+    """Host-store parity on levels spanning many chunks (n_chunks well
+    past the 4*G grouping threshold, where the host-store path stays
+    UNGROUPED by design — the group filter can't compact against its
+    dummy visited table; see the `grouping =` comment in bfs.py).  A
+    small config at a tiny chunk reproduces the deep sweep's many-chunk
+    shape: the ungrouped concat + host-side insert must neither drop
+    nor double-count states."""
+    from tla_raft_tpu.config import RaftConfig
+    from tla_raft_tpu.engine import JaxChecker
+    from tla_raft_tpu.oracle import OracleChecker
+
+    cfg = RaftConfig(n_servers=3, n_vals=2, max_election=2, max_restart=2)
+    want = OracleChecker(cfg).run(max_depth=12)
+
+    store = HostFPStore(str(tmp_path / "fp"), mem_budget_entries=1 << 12)
+    chk = JaxChecker(cfg, chunk=32, host_store=store)
+    got = chk.run(max_depth=12)
+    assert (got.ok, got.distinct, got.generated, got.depth, got.level_sizes) == (
+        want.ok, want.distinct, want.generated, want.depth, want.level_sizes,
+    )
+    assert len(store) == want.distinct
+    # the shape that matters: the deepest EXPANDED frontier (level 11,
+    # 2,925 states) spans ceil(2925/32) = 92 > 4*G chunks
+    assert -(-want.level_sizes[11] // 32) > 4 * chk.G

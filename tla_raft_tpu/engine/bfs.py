@@ -736,11 +736,22 @@ class JaxChecker:
         G = self.G  # chunks per visited-filter group
         n_chunks = -(-max(n_f, 1) // self.chunk)
         synced = 0  # chunks dispatched since the last queue drain
-        # group-filtering only pays (and only sizes correctly) once most
-        # candidates are revisits — at small frontiers the level-wide sort
-        # is tiny and new/parent ratios (up to ~2.5) would overflow cap_g.
-        # With a host store the device visited table is a dummy, so the
-        # filter could never drop anything.
+        # Group-wise compaction bounds the level-wide candidate concat to
+        # n_groups*cap_g lanes instead of n_chunks*cap_x, but ONLY
+        # because the group filter drops candidates already in the
+        # device visited table (deep levels are <=50% fresh; it does NO
+        # intra-group dedup).  It stays off at small frontiers (the
+        # level-wide sort is tiny and new/parent ratios up to ~2.5 would
+        # overflow cap_g) and with a host store, whose device table is a
+        # 64-entry dummy: the filter would keep every live lane, cap_g
+        # would overflow, and after growth the concat would match the
+        # ungrouped size at the cost of a wasted re-expansion.  That
+        # makes the ungrouped concat the HBM ceiling of the external-
+        # store path — level 25 of the reference sweep (11.1M-state
+        # frontier, 1,358 chunks) OOMs there (round 2).  The fix is
+        # per-GROUP host filtering (fetch each group's compacted fps,
+        # insert into the store, keep survivors host-side): device
+        # memory becomes O(group), not O(level).
         grouping = n_chunks > 4 * G and self.host_store is None
 
         def flush_group():
