@@ -49,6 +49,19 @@ GOLDEN_FULL = {
     # (S, V, max_election, max_restart): (distinct, generated, depth)
 }
 
+# Per-level new-state counts of the deepest verified record (BASELINE.md
+# "golden counts": levels 0-15 double-verified oracle+engine, 16+ device-
+# produced with disjoint-new delta audits).  Any bench run deep enough to
+# overlap this prefix is gated on it level for level — the numbers the
+# project leans on hardest must be regression-checked, not prose-only.
+GOLDEN_LEVELS = {
+    (3, 2, 3, 3): [
+        1, 1, 3, 9, 22, 57, 136, 345, 931, 2468, 5881, 12505, 24705,
+        47599, 91014, 169607, 301664, 511609, 839797, 1353766, 2150466,
+        3350017, 5099018, 7596394, 11125029,
+    ],
+}
+
 
 def main():
     os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
@@ -98,12 +111,38 @@ def main():
     if max_depth is not None:
         gold_depth = min(gold_depth, max_depth)
 
-    # one timed oracle run: the CPU baseline rate AND the golden prefix
+    # one timed oracle run: golden prefix + the (weak) Python baseline rate
     t0 = time.monotonic()
     gold = OracleChecker(cfg).run(max_depth=gold_depth)
     o_dt = time.monotonic() - t0
     oracle_rate = gold.distinct / o_dt
     assert gold.ok, "oracle found a violation on a known-clean config"
+
+    # the HONEST CPU baseline: the multithreaded native C++ checker of the
+    # same semantics (native/cpubase.cpp — the `tlc -workers N` stand-in;
+    # TLC itself is an external jar that cannot run here).  vs_baseline is
+    # measured against THIS, on the deepest prefix it can do in reasonable
+    # time; its per-level counts double as another parity anchor.
+    import json as _json
+    import subprocess as _sp
+
+    from tla_raft_tpu.native import build_cpubase
+
+    native_depth = int(os.environ.get(
+        "BENCH_NATIVE_DEPTH", str(min(max_depth or 19, 19))
+    ))
+    native = None
+    try:
+        nb = build_cpubase()
+        nproc = os.cpu_count() or 1
+        out_n = _sp.run(
+            [nb, str(cfg.S), str(cfg.V), str(cfg.max_election),
+             str(cfg.max_restart), str(native_depth), str(nproc)],
+            capture_output=True, text=True, timeout=3600, check=True,
+        )
+        native = _json.loads(out_n.stdout)
+    except Exception as e:  # keep benching even if the baseline breaks
+        print(f"[bench] native baseline failed: {e}", file=sys.stderr)
 
     # one full engine run; per-level timing feeds the steady-state metric
     t0 = time.monotonic()
@@ -138,10 +177,18 @@ def main():
     # ---- parity gates ---------------------------------------------------
     prefix = gold.level_sizes
     parity = res.ok and res.level_sizes[: len(prefix)] == prefix
+    if native is not None:
+        nlv = native["level_sizes"]
+        n = min(len(nlv), len(res.level_sizes))
+        parity = parity and list(res.level_sizes[:n]) == nlv[:n]
     golden_key = (cfg.S, cfg.V, cfg.max_election, cfg.max_restart)
     full_golden = GOLDEN_FULL.get(golden_key) if max_depth is None else None
     if full_golden is not None:
         parity = parity and (res.distinct, res.generated, res.depth) == full_golden
+    pinned = GOLDEN_LEVELS.get(golden_key)
+    if pinned is not None:
+        n = min(len(pinned), len(res.level_sizes))
+        parity = parity and list(res.level_sizes[:n]) == pinned[:n]
 
     out = {
         "metric": "raft_cfg_full_check"
@@ -149,7 +196,9 @@ def main():
         else f"raft_cfg_check_depth{max_depth}",
         "value": round(steady, 1),
         "unit": "distinct_states_per_sec",
-        "vs_baseline": round(steady / oracle_rate, 2),
+        "vs_baseline": round(
+            steady / (native["rate"] if native else oracle_rate), 2
+        ),
         "parity": parity,
         "distinct": res.distinct,
         "generated": res.generated,
@@ -157,8 +206,19 @@ def main():
         "ok": res.ok,
         "wall_s": round(dt, 2),
         "overall_rate": round(overall_rate, 1),
-        "baseline": {
-            "impl": "python_oracle",
+        "baseline": (
+            {
+                "impl": "cpubase_cpp",
+                "rate": round(native["rate"], 1),
+                "states": native["distinct"],
+                "depth_cap": native_depth,
+                "wall_s": native["seconds"],
+                "threads": native["threads"],
+            }
+            if native
+            else {"impl": "python_oracle", "rate": round(oracle_rate, 1)}
+        ),
+        "baseline_python_oracle": {
             "rate": round(oracle_rate, 1),
             "states": gold.distinct,
             "depth_cap": gold_depth,

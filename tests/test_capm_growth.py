@@ -1,0 +1,65 @@
+"""cap_m (sparse message-set width) auto-growth.
+
+The message set per reachable state grows ~1 per BFS level on this spec
+family, so any fixed lane budget is a time bomb on deep sweeps (VERDICT
+round 2, weak #6: "the only capacity in the engine that doesn't
+self-grow").  The engine must detect overflow during materialization,
+double the width, widen the frontier's id lanes and redo the level —
+both in a live run and in a delta-log replay.
+"""
+
+import numpy as np
+
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.oracle import OracleChecker
+
+CFG = RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1)
+
+
+def test_capm_grows_from_tiny_budget():
+    want = OracleChecker(CFG).run()
+    chk = JaxChecker(CFG, chunk=64, cap_m=2)
+    assert chk.cap_m == 2
+    got = chk.run()
+    assert (got.ok, got.distinct, got.generated, got.depth, got.level_sizes) == (
+        want.ok, want.distinct, want.generated, want.depth, want.level_sizes,
+    )
+    # the config genuinely needs more than the starting width
+    assert chk.cap_m > 2
+
+
+def test_capm_growth_during_delta_replay(tmp_path):
+    want = OracleChecker(CFG).run()
+    ckdir = str(tmp_path / "states")
+    full = JaxChecker(CFG, chunk=64)
+    full.run(max_depth=4, checkpoint_dir=ckdir, checkpoint_every=1)
+    assert full.cap_m > 2
+    # resume with a starving budget: the replay's materialize pass must
+    # grow it, then the continued run must finish with exact parity
+    chk = JaxChecker(CFG, chunk=64, cap_m=2)
+    got = chk.run(resume_from=ckdir)
+    assert (got.ok, got.distinct, got.generated, got.depth, got.level_sizes) == (
+        want.ok, want.distinct, want.generated, want.depth, want.level_sizes,
+    )
+    assert chk.cap_m > 2
+
+
+def test_capm_growth_matches_fixed_budget_bitwise(tmp_path):
+    """The grown run's delta log is bit-identical to a comfortable-budget
+    run's: growth is pure re-computation, never a semantic change."""
+    import glob
+
+    a = str(tmp_path / "a")
+    b = str(tmp_path / "b")
+    JaxChecker(CFG, chunk=64, cap_m=2).run(
+        checkpoint_dir=a, checkpoint_every=1
+    )
+    JaxChecker(CFG, chunk=64).run(checkpoint_dir=b, checkpoint_every=1)
+    fa = sorted(glob.glob(a + "/delta_*.npz"))
+    fb = sorted(glob.glob(b + "/delta_*.npz"))
+    assert fa and len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        za, zb = np.load(x), np.load(y)
+        for k in ("pidx", "slot", "fps", "mult"):
+            assert np.array_equal(za[k], zb[k]), (x, k)

@@ -193,13 +193,13 @@ def test_host_store_resume_from_monolith_anchored_delta_log(tmp_path, built):
 
 
 def test_host_store_many_chunk_level_parity(tmp_path, built):
-    """Host-store parity on levels spanning many chunks (n_chunks well
-    past the 4*G grouping threshold, where the host-store path stays
-    UNGROUPED by design — the group filter can't compact against its
-    dummy visited table; see the `grouping =` comment in bfs.py).  A
-    small config at a tiny chunk reproduces the deep sweep's many-chunk
-    shape: the ungrouped concat + host-side insert must neither drop
-    nor double-count states."""
+    """Host-store parity on levels spanning many chunks — the per-group
+    host-filtering path (one ``_group_unique`` + host fetch per G chunks,
+    level-global representative choice + visited filter in numpy; device
+    memory O(group)).  A small config at a tiny chunk reproduces the deep
+    sweep's many-group shape: the per-group dedup + host-side merge must
+    neither drop nor double-count states, and must pick the same
+    min-(fp_full, payload) representatives as the device-wide dedup."""
     from tla_raft_tpu.config import RaftConfig
     from tla_raft_tpu.engine import JaxChecker
     from tla_raft_tpu.oracle import OracleChecker
@@ -217,3 +217,156 @@ def test_host_store_many_chunk_level_parity(tmp_path, built):
     # the shape that matters: the deepest EXPANDED frontier (level 11,
     # 2,925 states) spans ceil(2925/32) = 92 > 4*G chunks
     assert -(-want.level_sizes[11] // 32) > 4 * chk.G
+
+
+def test_intra_level_crash_resume_bit_identical(tmp_path, built):
+    """A crash mid-level on the external-store path costs only the groups
+    not yet spilled: completed groups' unique candidates persist as
+    ``partial_*.npz`` and a resume replays the delta log, loads them, and
+    re-expands only the rest — with bit-identical level output (VERDICT
+    round 2, missing #4: -recover-grade durability inside a level)."""
+    import numpy as np
+
+    from tla_raft_tpu.config import RaftConfig
+    from tla_raft_tpu.engine import JaxChecker
+    from tla_raft_tpu.oracle import OracleChecker
+
+    cfg = RaftConfig(n_servers=3, n_vals=2, max_election=2, max_restart=2)
+    # oracle level sizes: (1,1,3,6,12,23,60,170,439,940,1721,2925) — the
+    # level-11 expansion (1,721 parents at chunk 32) spans 54 chunks = 4
+    # groups at G=16
+    depth_cap = 11
+    want = OracleChecker(cfg).run(max_depth=depth_cap)
+
+    ckdir = str(tmp_path / "states")
+    store = HostFPStore(str(tmp_path / "fp"), mem_budget_entries=1 << 12)
+    chk = JaxChecker(cfg, chunk=32, host_store=store)
+    chk.run(max_depth=9, checkpoint_dir=ckdir, checkpoint_every=1)
+
+    # "crash" mid-level-11: level 10 (30 chunks) completes, then level
+    # 11's groups 0 and 1 (32 chunks) complete, then 2 chunks into group
+    # 2 the worker dies
+    store2 = HostFPStore(str(tmp_path / "fp"), mem_budget_entries=1 << 12)
+    chk2 = JaxChecker(cfg, chunk=32, host_store=store2)
+    real_expand = chk2._expand_chunk
+    calls = {"n": 0}
+
+    def dying_expand(*a, **kw):
+        if calls["n"] >= 64:
+            raise RuntimeError("simulated tunnel crash")
+        calls["n"] += 1
+        return real_expand(*a, **kw)
+
+    chk2._expand_chunk = dying_expand
+    with pytest.raises(RuntimeError, match="simulated tunnel crash"):
+        chk2.run(
+            max_depth=depth_cap, checkpoint_dir=ckdir, checkpoint_every=1,
+            resume_from=ckdir,
+        )
+    import glob
+
+    # level 11's completed groups survived; level 10's were wiped with its
+    # delta save
+    assert len(glob.glob(f"{ckdir}/partial_0011_*.npz")) == 2
+    assert len(glob.glob(f"{ckdir}/partial_*.npz")) == 2
+
+    # resume: loaded partials replace their groups' expansion entirely
+    store3 = HostFPStore(str(tmp_path / "fp"), mem_budget_entries=1 << 12)
+    chk3 = JaxChecker(cfg, chunk=32, host_store=store3)
+    real3 = chk3._expand_chunk
+    seen_starts = []
+
+    def counting_expand(part_f, start, n_f):
+        seen_starts.append(int(np.asarray(start)))
+        return real3(part_f, start, n_f)
+
+    chk3._expand_chunk = counting_expand
+    got = chk3.run(
+        max_depth=depth_cap, checkpoint_dir=ckdir, checkpoint_every=1,
+        resume_from=ckdir,
+    )
+    assert (got.ok, got.distinct, got.generated, got.depth, got.level_sizes) == (
+        want.ok, want.distinct, want.generated, want.depth, want.level_sizes,
+    )
+    # the resumed run replayed to depth 10, loaded groups 0-1 from the
+    # partials and expanded only level 11's remaining chunks 32..53
+    assert len(seen_starts) == 54 - 32
+    assert seen_starts == [32 * c for c in range(32, 54)]
+    assert not glob.glob(f"{ckdir}/partial_*.npz")  # wiped after each level
+
+    # a second, crash-free run over the same log is bit-identical: the
+    # delta files' fps arrays match level for level
+    deltas = sorted(glob.glob(f"{ckdir}/delta_*.npz"))
+    store4 = HostFPStore(str(tmp_path / "fp4"), mem_budget_entries=1 << 12)
+    ckdir4 = str(tmp_path / "states4")
+    JaxChecker(cfg, chunk=32, host_store=store4).run(
+        max_depth=depth_cap, checkpoint_dir=ckdir4, checkpoint_every=1
+    )
+    for f in deltas:
+        f4 = f.replace(ckdir, ckdir4)
+        za, zb = np.load(f), np.load(f4)
+        assert np.array_equal(za["fps"], zb["fps"]), f
+        assert np.array_equal(za["pidx"], zb["pidx"]), f
+        assert np.array_equal(za["slot"], zb["slot"]), f
+
+
+def test_stale_partials_are_ignored(tmp_path, built):
+    """Partials whose meta doesn't match the in-flight level (other level,
+    other chunk/cap_x/G — e.g. after a cap_x growth redo) must be deleted
+    and re-expanded, never loaded."""
+    import numpy as np
+
+    from tla_raft_tpu.config import RaftConfig
+    from tla_raft_tpu.engine import JaxChecker
+    from tla_raft_tpu.oracle import OracleChecker
+
+    cfg = RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1)
+    want = OracleChecker(cfg).run()
+    ckdir = tmp_path / "states"
+    ckdir.mkdir()
+    # a poison partial: plausible name, wrong meta (chunk=999), garbage fps
+    np.savez(
+        str(ckdir / "partial_0001_00000.npz"),
+        hv=np.arange(50, dtype=np.uint64),
+        hf=np.arange(50, dtype=np.uint64),
+        hp=np.zeros(50, np.int64),
+        mult=np.zeros(1, np.int64),
+        meta=np.asarray([1, 0, 999, 4, 16, 1, 1], np.int64),
+    )
+    (ckdir / "partial_0002_00099.npz").write_bytes(b"not an npz")
+    store = HostFPStore(str(tmp_path / "fp"), mem_budget_entries=64)
+    got = JaxChecker(cfg, chunk=64, host_store=store).run(
+        checkpoint_dir=str(ckdir), checkpoint_every=1
+    )
+    assert (got.ok, got.distinct, got.generated, got.level_sizes) == (
+        want.ok, want.distinct, want.generated, want.level_sizes,
+    )
+    assert not list(ckdir.glob("partial_*.npz"))
+
+
+def test_host_store_mutation_violations(tmp_path, built):
+    """The external-store path must report violations exactly like the
+    device path: the split-brain abort fires before anything reaches the
+    store (no corruption), and invariant violations surface post-filter."""
+    from tla_raft_tpu.config import RaftConfig
+    from tla_raft_tpu.engine import JaxChecker
+    from tla_raft_tpu.oracle import OracleChecker
+    from tla_raft_tpu.oracle.explicit import successors
+
+    for mutation, marker in (("double-vote", "split brain"), ("median-bug", "Inv")):
+        cfg = RaftConfig(
+            n_servers=3, n_vals=1, max_election=2, max_restart=0,
+            mutations=(mutation,),
+        )
+        want = OracleChecker(cfg).run()
+        store = HostFPStore(
+            str(tmp_path / f"fp_{mutation}"), mem_budget_entries=256
+        )
+        got = JaxChecker(cfg, chunk=32, host_store=store).run()
+        assert not want.ok and not got.ok
+        assert marker in got.violation[0]
+        assert got.depth == want.depth
+        assert got.level_sizes == want.level_sizes
+        kind, trace = got.violation
+        for (_, a), (act, b) in zip(trace, trace[1:]):
+            assert any(ch == b for _n, _s, _d, ch in successors(cfg, a)), act

@@ -42,6 +42,21 @@ def test_skeleton_extraction():
         (lambda s: s.replace("Inv ==\n  LeaderHasAllCommittedEntries", "Inv ==\n  NoSplitVote"), "Inv binds"),
         # drop msgs from the VIEW projection
         (lambda s: s.replace("msgs, role>>", "role>>"), "VIEW projection"),
+        # SEMANTIC edits inside action bodies — structurally invisible,
+        # caught only by the pinned body hashes (VERDICT round 2, weak #5):
+        # weaken ResponseVote's up-to-date check (Raft.tla:147)
+        (lambda s: s.replace("m.lastLogIndex >= lastLogIndex",
+                             "m.lastLogIndex > lastLogIndex"),
+         "ResponseVote differs semantically"),
+        # Median's rank-select flipped to one order statistic high (the
+        # "introduce mistack" bug family, Raft.tla:65-66)
+        (lambda s: s.replace("F[p] <= F[s] }) >= MajoritySize",
+                             "F[p] <= F[s] }) > MajoritySize"),
+         "Median differs semantically"),
+        # over-commit: LeaderCanCommit at a bare majority minus one
+        (lambda s: s.replace("MajoritySize == Cardinality(Servers) \\div 2 + 1",
+                             "MajoritySize == Cardinality(Servers) \\div 2"),
+         "MajoritySize differs semantically"),
     ],
 )
 def test_mutated_specs_rejected(tmp_path, mutation, needle):

@@ -116,6 +116,22 @@ def _pow2(n: int) -> int:
     return 1 << max(0, (n - 1)).bit_length()
 
 
+@functools.lru_cache(maxsize=1)
+def _is_tunneled() -> bool:
+    """True when the backend is a remote PJRT tunnel (the 'axon' proxy).
+
+    Tunneled workers need the per-chunk dispatch-queue drain (they crash
+    under deep async queues); local backends don't."""
+    try:
+        import jax.extend.backend
+
+        return "axon" in str(
+            getattr(jax.extend.backend.get_backend(), "platform_version", "")
+        )
+    except Exception:
+        return False
+
+
 def _cap4(n: int) -> int:
     """Next power of 4: capacities quantize coarser so the checker compiles
     ~half as many program shapes (remote TPU compiles are minutes each)."""
@@ -245,6 +261,35 @@ def _level_dedup(cv, cf, cp, visited):
 
 
 @jax.jit
+def _group_unique(cv, cf, cp):
+    """Intra-group dedup for the external-store path.
+
+    Picks the min-(fp_full, payload) representative per view fingerprint
+    within one group of chunks and compacts the survivors to a fetchable
+    prefix (cv-ascending) — the same ordering contract as
+    ``_level_dedup`` but with no visited access: the visited filter
+    happens host-side against the external store.  Keeping only the
+    group-min per view is lossless for the level-global representative
+    choice (the global min over candidates equals the min over
+    group-mins), which is what makes the per-group host path bit-
+    identical to the level-wide device dedup.
+    """
+    order = jnp.lexsort((cp, cf, cv))
+    sv, sf, sp = cv[order], cf[order], cp[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), sv[1:] != sv[:-1]])
+    keep = first & (sv != SENT)
+    n_u = keep.sum()
+    comp = jnp.argsort(~keep, stable=True)
+    pref = jnp.arange(sv.shape[0]) < n_u
+    return (
+        n_u,
+        jnp.where(pref, sv[comp], SENT),
+        jnp.where(pref, sf[comp], SENT),
+        jnp.where(pref, sp[comp], -1),
+    )
+
+
+@jax.jit
 def _merge_sorted(visited, new_fps):
     """Insert a level's new fingerprints into the sorted store."""
     return jnp.sort(jnp.concatenate([visited, new_fps]))
@@ -285,7 +330,8 @@ class JaxChecker:
         self.K = self.kern.K
         self.uni_words = self.kern.uni.n_words
         # sparse-frontier width: max message-set size per reachable state
-        # (grows ~1/level; a run raises cleanly on overflow — bump cap_m)
+        # (grows ~1/level; overflow auto-doubles it and re-materializes
+        # the level — see _materialize_grow)
         self.cap_m = min(cap_m, self.kern.uni.M)
         self.id_dtype = jnp.int16 if self.kern.uni.M < (1 << 15) else jnp.int32
         if chunk & (chunk - 1):
@@ -302,8 +348,17 @@ class JaxChecker:
         # overflow grows cap_g like cap_x)
         self.G = 16
         self.cap_g = self.G * self.cap_x // 2
-        # chunks dispatched between queue-draining scalar fetches
-        self.sync_every = 1
+        # chunks dispatched between queue-draining scalar fetches.  The
+        # tunneled (remote PJRT) device worker crashes when too many chunk
+        # programs queue on multi-million-state levels — even a 32-chunk
+        # window died — so the per-chunk drain is the default there
+        # (~10 ms against a ~400 ms chunk).  Healthy local hardware
+        # doesn't need the serialization; the env knob opens the window.
+        env_sync = os.environ.get("TLA_RAFT_SYNC_EVERY")
+        if env_sync is not None:
+            self.sync_every = max(1, int(env_sync))
+        else:
+            self.sync_every = 1 if _is_tunneled() else 8
         self.progress = progress
         # optional native external-memory visited store (native/fpstore.cpp);
         # when set, the device keeps no visited table at all — the level's
@@ -499,10 +554,14 @@ class JaxChecker:
                     level_mult, n_new):
         os.makedirs(ckdir, exist_ok=True)
         tmp = os.path.join(ckdir, f".tmp_delta_{depth:04d}.npz")
+        # slot ids must round-trip the log exactly; K grows with the
+        # S/T/L/V bounds (3,696 at S=7), so widen past the u16 range
+        # rather than silently wrapping (the loader reads either width)
+        slot_dt = np.uint16 if self.K <= 0xFFFF else np.uint32
         np.savez(
             tmp,
             pidx=pidx_np.astype(np.uint32),
-            slot=slot_np.astype(np.uint16),
+            slot=slot_np.astype(slot_dt),
             fps=fps_np.astype(np.uint64),
             mult=level_mult.astype(np.int64),
             meta=np.asarray([depth, n_new], np.int64),
@@ -526,6 +585,46 @@ class JaxChecker:
             if si % 4 == 3:
                 jax.device_get(bad_d)  # bound the dispatch queue
         return child_parts, bad_ds, ovf_ds, n_slices, sl
+
+    def _widen_msg_ids(self, frontier: Frontier) -> Frontier:
+        """Pad the frontier's sparse message-id lanes out to self.cap_m."""
+        ids = frontier.msg_ids
+        pad = self.cap_m - ids.shape[1]
+        if pad <= 0:
+            return frontier
+        return frontier._replace(
+            msg_ids=jnp.concatenate(
+                [ids, jnp.full((ids.shape[0], pad), -1, ids.dtype)], axis=1
+            )
+        )
+
+    def _materialize_grow(self, frontier, new_payload, n_new):
+        """Materialize survivors, auto-growing cap_m on overflow.
+
+        cap_m (the sparse-frontier message-set width) grows ~1 per BFS
+        level on the reference family; a fixed budget would make deep
+        sweeps die hours in (VERDICT round 2, weak #6).  Overflow is
+        detected per slice by ``_msgs_to_ids``; the payloads are already
+        known, so doubling the width, widening the (parent) frontier's id
+        lanes and re-materializing the level is pure re-computation —
+        the same recovery shape as the cap_x growth redo.  Returns
+        (child_parts, bads, n_slices, sl, frontier) with the possibly-
+        widened frontier.
+        """
+        while True:
+            parts, bad_ds, ovf_ds, n_slices, sl = (
+                self._materialize_payload_slices(frontier, new_payload, n_new)
+            )
+            bads, ovfs = jax.device_get((bad_ds, ovf_ds))
+            if not any(bool(np.asarray(o)) for o in ovfs):
+                return parts, bads, n_slices, sl, frontier
+            if self.cap_m >= self.kern.uni.M:
+                raise RuntimeError(
+                    "message-set width exceeds the whole universe — "
+                    "corrupt payloads?"
+                )
+            self.cap_m = min(2 * self.cap_m, self.kern.uni.M)
+            frontier = self._widen_msg_ids(frontier)
 
     def _resume_from_deltas(self, ckdir):
         """Rebuild the run state by replaying the delta log.
@@ -599,14 +698,9 @@ class JaxChecker:
             payload_np = pidx * K + slot
             cap = max(_pow2(n_new), 4 * self.chunk)
             new_payload = _pad_axis0(jnp.asarray(payload_np, I64), cap)
-            parts, _bads, ovfs, _ns, _sl = self._materialize_payload_slices(
+            parts, _bads, _ns, _sl, frontier = self._materialize_grow(
                 frontier, new_payload, n_new
             )
-            if any(bool(np.asarray(o)) for o in ovfs):
-                raise RuntimeError(
-                    f"cap_m overflow replaying level {d}; rerun with a "
-                    f"larger cap_m"
-                )
             cap_f = max(_pow2(n_new), self.chunk)
             frontier = None  # drop the parent copy before the concat
             frontier = jax.tree.map(
@@ -719,7 +813,23 @@ class JaxChecker:
 
     # -- the main loop -----------------------------------------------------
 
-    def _expand_level(self, frontier: Frontier, n_f, visited):
+    def _expand_level(self, frontier: Frontier, n_f, visited, ckdir=None,
+                      depth=None):
+        """Expand all chunks of one level.
+
+        Dispatches between the two dedup tiers: with an external host
+        store the level runs per-group host filtering (device memory
+        O(group) — the fix for the round-2 level-25 HBM ceiling, where
+        the ungrouped level-wide candidate concat OOMed at an 11.1M-state
+        frontier); with a device-resident visited table the level-wide
+        on-device dedup is cheaper.  ``ckdir``/``depth`` enable
+        intra-level (per-group) partial checkpoints on the host path.
+        """
+        if self.host_store is not None:
+            return self._expand_level_host(frontier, n_f, ckdir, depth)
+        return self._expand_level_device(frontier, n_f, visited)
+
+    def _expand_level_device(self, frontier: Frontier, n_f, visited):
         """Expand all chunks; returns device arrays + one fused host fetch.
 
         The frontier is device-resident in compact form; chunks are
@@ -742,17 +852,8 @@ class JaxChecker:
         # device visited table (deep levels are <=50% fresh; it does NO
         # intra-group dedup).  It stays off at small frontiers (the
         # level-wide sort is tiny and new/parent ratios up to ~2.5 would
-        # overflow cap_g) and with a host store, whose device table is a
-        # 64-entry dummy: the filter would keep every live lane, cap_g
-        # would overflow, and after growth the concat would match the
-        # ungrouped size at the cost of a wasted re-expansion.  That
-        # makes the ungrouped concat the HBM ceiling of the external-
-        # store path — level 25 of the reference sweep (11.1M-state
-        # frontier, 1,358 chunks) OOMs there (round 2).  The fix is
-        # per-GROUP host filtering (fetch each group's compacted fps,
-        # insert into the store, keep survivors host-side): device
-        # memory becomes O(group), not O(level).
-        grouping = n_chunks > 4 * G and self.host_store is None
+        # overflow cap_g).
+        grouping = n_chunks > 4 * G
 
         def flush_group():
             while len(cvs) < G:  # pad the group to its fixed width
@@ -828,6 +929,163 @@ class JaxChecker:
             mult_np,
         )
 
+    # -- external-store path: per-group host filtering ---------------------
+    #
+    # The device never holds more than one group (G chunks) of candidates:
+    # each group is deduped on device (min-(fp_full, payload) representative
+    # per view fp, ``_group_unique``), its unique candidates are fetched,
+    # and the level-global choice + visited filter run host-side — a numpy
+    # lexsort with exactly ``_level_dedup``'s ordering, then one batched
+    # ``host_store.insert``.  Device memory is O(G * cap_x) regardless of
+    # frontier size, which removes the round-2 ceiling (11.1M-state level
+    # 25 OOMed on the level-wide concat).  Per-group fetches double as the
+    # dispatch-queue drains the tunneled device needs anyway.
+    #
+    # Groups are also the unit of intra-level durability: each completed
+    # group's unique candidates land in ``partial_####_#####.npz`` before
+    # the next group starts, so a mid-level crash costs one group, not the
+    # level (TLC's mid-level ``states/`` queue spill analog; the level-23
+    # corruption saga in BASELINE.md is the motivation).  Partials are
+    # self-validating (level, chunk, cap_x, G, K, n_f in the meta) — BFS
+    # determinism makes a matching partial's contents exact.
+
+    def _expand_level_host(self, frontier: Frontier, n_f, ckdir=None,
+                           depth=None):
+        n_f_dev = jnp.asarray(n_f, I64)
+        G = self.G
+        n_chunks = -(-max(n_f, 1) // self.chunk)
+        n_groups = -(-n_chunks // G)
+        level = (depth + 1) if depth is not None else None
+        hv, hf, hp = [], [], []  # per-group unique candidates, host-side
+        mult_np = np.zeros((self.K,), np.int64)
+        saved = self._load_partials(ckdir, level, n_f) if ckdir else {}
+        for gi in range(n_groups):
+            if gi in saved:
+                z = saved[gi]
+                hv.append(z["hv"])
+                hf.append(z["hf"])
+                hp.append(z["hp"])
+                mult_np += z["mult"]
+                continue
+            cvs, cfs, cps = [], [], []
+            mult_acc = jnp.zeros((self.K,), I64)
+            abort_at = BIG
+            overflow = jnp.zeros((), bool)
+            synced = 0
+            for ci in range(gi * G, min((gi + 1) * G, n_chunks)):
+                part_f = jax.tree.map(
+                    lambda x: jax.lax.dynamic_slice_in_dim(
+                        x, ci * self.chunk, self.chunk
+                    ),
+                    frontier,
+                )
+                cv, cf, cp, mult_slots, ab_at, ovf = self._expand_chunk(
+                    part_f, jnp.asarray(ci * self.chunk, I64), n_f_dev
+                )
+                cvs.append(cv)
+                cfs.append(cf)
+                cps.append(cp)
+                mult_acc = mult_acc + mult_slots
+                abort_at = jnp.minimum(abort_at, ab_at)
+                overflow = overflow | ovf
+                synced += 1
+                if synced >= self.sync_every:
+                    jax.device_get(abort_at)
+                    synced = 0
+            while len(cvs) < G:  # pad the group to its fixed width
+                cvs.append(jnp.full((self.cap_x,), SENT, U64))
+                cfs.append(jnp.full((self.cap_x,), SENT, U64))
+                cps.append(jnp.full((self.cap_x,), -1, I64))
+            n_u_dev, gv, gf, gp = _group_unique(
+                jnp.concatenate(cvs), jnp.concatenate(cfs),
+                jnp.concatenate(cps),
+            )
+            n_u, ab, ovf_h, mult_g = jax.device_get(
+                (n_u_dev, abort_at, overflow, mult_acc)
+            )
+            mult_np += np.asarray(mult_g, np.int64)
+            if int(ab) < n_f or bool(ovf_h):
+                # abort (split-brain) or cap_x overflow: nothing reached
+                # the store yet, so run() can report the trace / grow the
+                # budget and redo the level cleanly (a redo's changed
+                # cap_x also invalidates this level's partials — the meta
+                # check drops them)
+                return (0, None, None, int(ab), bool(ovf_h), False, mult_np)
+            n_u = int(n_u)
+            gv_np = np.asarray(gv[:n_u])
+            gf_np = np.asarray(gf[:n_u])
+            gp_np = np.asarray(gp[:n_u])
+            hv.append(gv_np)
+            hf.append(gf_np)
+            hp.append(gp_np)
+            if ckdir:
+                self._save_partial(
+                    ckdir, level, gi, gv_np, gf_np, gp_np,
+                    np.asarray(mult_g, np.int64), n_f,
+                )
+        # ---- level-global representative choice + visited filter --------
+        av = np.concatenate(hv) if hv else np.empty(0, np.uint64)
+        af = np.concatenate(hf) if hf else np.empty(0, np.uint64)
+        ap = np.concatenate(hp) if hp else np.empty(0, np.int64)
+        order = np.lexsort((ap, af, av))
+        sv, sp = av[order], ap[order]
+        first = np.ones(len(sv), bool)
+        first[1:] = sv[1:] != sv[:-1]
+        uniq_v, uniq_p = sv[first], sp[first]
+        is_new = self.host_store.insert(uniq_v)
+        new_fps = np.ascontiguousarray(uniq_v[is_new])
+        new_pay = np.ascontiguousarray(uniq_p[is_new])
+        return (len(new_fps), new_fps, new_pay, int(BIG), False, False,
+                mult_np)
+
+    def _save_partial(self, ckdir, level, gi, hv, hf, hp, mult, n_f):
+        os.makedirs(ckdir, exist_ok=True)
+        name = f"partial_{level:04d}_{gi:05d}.npz"
+        tmp = os.path.join(ckdir, f".tmp_partial_{level:04d}_{gi:05d}.npz")
+        np.savez(
+            tmp, hv=hv, hf=hf, hp=hp, mult=mult,
+            meta=np.asarray(
+                [level, gi, self.chunk, self.cap_x, self.G, self.K, n_f],
+                np.int64,
+            ),
+        )
+        os.replace(tmp, os.path.join(ckdir, name))
+
+    def _load_partials(self, ckdir, level, n_f):
+        """Completed-group partials for this level; stale ones are wiped.
+
+        A partial is valid only if its meta matches the in-flight level
+        exactly (a cap_x growth redo or a chunk-size change moves every
+        group boundary).  Partials from other levels are leftovers of a
+        crash between the delta save and the wipe — delete them."""
+        import glob
+
+        out = {}
+        for f in sorted(glob.glob(os.path.join(ckdir, "partial_*.npz"))):
+            try:
+                z = np.load(f)
+                meta = tuple(int(x) for x in z["meta"])
+                want = (level, meta[1], self.chunk, self.cap_x, self.G,
+                        self.K, n_f)
+                if level is None or meta != want:
+                    os.unlink(f)
+                    continue
+                rec = dict(
+                    hv=z["hv"], hf=z["hf"], hp=z["hp"],
+                    mult=z["mult"].astype(np.int64),
+                )
+            except Exception:
+                os.unlink(f)  # truncated by a crash mid-write
+                continue
+            out[meta[1]] = rec
+        return out
+
+    def _wipe_partials(self, ckdir):
+        import glob
+
+        for f in glob.glob(os.path.join(ckdir, "partial_*.npz")):
+            os.unlink(f)
+
     def run(
         self,
         max_depth: int | None = None,
@@ -862,6 +1120,24 @@ class JaxChecker:
                     "already holds another run's checkpoints — the two "
                     "logs would interleave; clear it or checkpoint into "
                     "the resumed directory itself"
+                )
+            if (
+                resume_from is not None
+                and not os.path.isdir(resume_from)
+                and os.path.abspath(resume_from)
+                == os.path.abspath(os.path.join(checkpoint_dir, "base.npz"))
+                and stale
+            ):
+                # resuming from the directory's own base monolith while it
+                # already holds deltas would re-append a second chain on
+                # top of the existing one (stale deeper deltas would then
+                # replay with no gap error) — the directory itself is the
+                # correct resume point
+                raise ValueError(
+                    f"{checkpoint_dir} holds delta checkpoints beyond its "
+                    "base.npz; resume from the directory itself (delta "
+                    "replay) instead of the base monolith, or clear the "
+                    "deltas first"
                 )
             if (
                 resume_from is not None
@@ -950,7 +1226,11 @@ class JaxChecker:
             # --- expand + compact-then-dedup (device), fused level fetch -
             while True:
                 (n_new, new_fps, new_payload, abort_at, overflow, overflow_g,
-                 level_mult) = self._expand_level(frontier, n_f, visited)
+                 level_mult) = self._expand_level(
+                    frontier, n_f, visited,
+                    ckdir=checkpoint_dir if checkpoint_every else None,
+                    depth=depth,
+                )
                 if not (overflow or overflow_g):
                     break
                 # a lane budget overflowed: grow it and redo the level
@@ -977,14 +1257,13 @@ class JaxChecker:
             generated += int(level_mult.sum())
 
             fps_host = None  # host-filtered level fps (delta-log record)
+            pay_host = None  # host-side payloads (external-store path)
             if self.host_store is not None and n_new:
-                fps_np = np.asarray(new_fps[:n_new])
-                is_new = self.host_store.insert(fps_np)
-                filtered = np.asarray(new_payload[:n_new])[is_new]
-                fps_host = fps_np[is_new]
-                n_new = len(filtered)
+                # _expand_level_host already ran the store filter; its
+                # outputs are host-side numpy (fps cv-ascending + payloads)
+                fps_host, pay_host = new_fps, new_payload
                 new_payload = _pad_axis0(
-                    jnp.asarray(filtered), max(_pow2(n_new), 4 * self.chunk)
+                    jnp.asarray(pay_host), max(_pow2(n_new), 4 * self.chunk)
                 )
             if n_new == 0:
                 break
@@ -992,22 +1271,24 @@ class JaxChecker:
             # --- materialize the survivors (device-resident) ------------
             # slice width must not exceed the payload capacity (a custom
             # cap_x < 4*chunk shrinks the dedup output below 4*chunk)
-            child_parts, bad_ds, ovf_ds, n_slices, sl = (
-                self._materialize_payload_slices(frontier, new_payload, n_new)
+            child_parts, bads, n_slices, sl, frontier = (
+                self._materialize_grow(frontier, new_payload, n_new)
             )
-            # one fused fetch of the per-slice scalars + the trace spill
-            pidx32 = (new_payload[: n_slices * sl] // K).astype(U32C)
-            slot16 = (new_payload[: n_slices * sl] % K).astype(jnp.uint16)
-            bads, ovfs, pidx_np, slot_np = jax.device_get(
-                (bad_ds, ovf_ds, pidx32, slot16)
-            )
-            pidx_np = pidx_np[:n_new].astype(np.int64)
-            slot_np = slot_np[:n_new].astype(np.int64)
-            if any(ovfs):
-                raise RuntimeError(
-                    f"message-set width exceeded cap_m={self.cap_m} at "
-                    f"level {depth + 1}; rerun with a larger cap_m"
-                )
+            # trace spill: the external-store path already holds the
+            # payloads host-side — no device round-trip there
+            if pay_host is not None:
+                pidx_np = (pay_host // K).astype(np.int64)
+                slot_np = (pay_host % K).astype(np.int64)
+            else:
+                pidx32 = (new_payload[: n_slices * sl] // K).astype(U32C)
+                # fetch width must match _save_delta's: a u16 cast here
+                # would wrap slots at K > 65535 before the widened save
+                # ever saw them
+                slot_jdt = jnp.uint16 if K <= 0xFFFF else jnp.uint32
+                slot16 = (new_payload[: n_slices * sl] % K).astype(slot_jdt)
+                pidx_np, slot_np = jax.device_get((pidx32, slot16))
+                pidx_np = pidx_np[:n_new].astype(np.int64)
+                slot_np = slot_np[:n_new].astype(np.int64)
             bad_idx = -1
             for si, b in enumerate(bads):
                 if b >= 0:
@@ -1084,6 +1365,10 @@ class JaxChecker:
                     checkpoint_dir, depth, pidx_np, slot_np, fps_np,
                     level_mult, n_new,
                 )
+                if self.host_store is not None:
+                    # the level's per-group partials are superseded by its
+                    # delta record (only the in-flight level ever has any)
+                    self._wipe_partials(checkpoint_dir)
 
         return CheckResult(
             True, distinct, generated, depth, tuple(level_sizes), None,

@@ -18,6 +18,8 @@ import numpy as np
 _DIR = os.path.dirname(__file__)
 _SRC = os.path.join(_DIR, "fpstore.cpp")
 _SO = os.path.join(_DIR, "libfpstore.so")
+_BASE_SRC = os.path.join(_DIR, "cpubase.cpp")
+_BASE_BIN = os.path.join(_DIR, "cpubase")
 
 
 def build_native(force: bool = False) -> str:
@@ -36,6 +38,29 @@ def build_native(force: bool = False) -> str:
     )
     os.replace(tmp, _SO)
     return _SO
+
+
+def build_cpubase(force: bool = False) -> str:
+    """Compile cpubase.cpp -> the native CPU baseline checker binary.
+
+    The multithreaded C++ explicit-state checker of the same spec family
+    (the honest stand-in for `tlc -workers N`, BASELINE.md) — bench.py
+    measures `vs_baseline` against it."""
+    if (
+        not force
+        and os.path.exists(_BASE_BIN)
+        and os.path.getmtime(_BASE_BIN) >= os.path.getmtime(_BASE_SRC)
+    ):
+        return _BASE_BIN
+    tmp = _BASE_BIN + ".tmp"
+    subprocess.run(
+        ["g++", "-O3", "-march=native", "-std=c++17", "-pthread", "-w",
+         _BASE_SRC, "-o", tmp],
+        check=True,
+        capture_output=True,
+    )
+    os.replace(tmp, _BASE_BIN)
+    return _BASE_BIN
 
 
 _lib = None

@@ -340,6 +340,17 @@ class Fingerprinter:
         whose integer values stay < 2^24, so it runs exactly in f32 on
         the MXU (see _msg_hash_factored)."""
         uni = self.uni
+        # Exactness precondition of the f32 fold: every folded partial is a
+        # sum of at most M plane bytes (|plane| <= 127), so it stays exact
+        # in f32 only while 127*M < 2^24.  Current universes are far below
+        # (S=7 full M=33,768 -> 4.3M) but a future scale dial must fail
+        # loudly here, not round silently into wrong canonical fingerprints.
+        if 127 * uni.M >= (1 << 24):
+            raise ValueError(
+                f"factored message hash exactness bound violated: "
+                f"127*M = {127 * uni.M} >= 2^24; use the monolithic path "
+                f"(force_factored=False) or add an int fold for this size"
+            )
         NP = uni.S * (uni.S - 1)
         self._NP = NP
         self._Gt_planes = []
