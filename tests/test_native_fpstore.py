@@ -312,8 +312,10 @@ def test_intra_level_crash_resume_bit_identical(tmp_path, built):
 
 def test_stale_partials_are_ignored(tmp_path, built):
     """Partials whose meta doesn't match the in-flight level (other level,
-    other chunk/cap_x/G — e.g. after a cap_x growth redo) must be deleted
-    and re-expanded, never loaded."""
+    other chunk/G/K) must be deleted and re-expanded, never loaded.
+    (cap_x deliberately does NOT participate: a completed group's
+    candidate set is budget-independent, so a cap_x-growth redo keeps
+    its partials — see _load_partials.)"""
     import numpy as np
 
     from tla_raft_tpu.config import RaftConfig

@@ -89,8 +89,10 @@ def test_sharded_split_brain_abort_trace():
 
 
 def test_sharded_checkpoint_resume(tmp_path):
-    """Stop a mesh run mid-sweep, resume from the snapshot, and land on
-    exactly the uninterrupted run's numbers (TLC -recover analog)."""
+    """Stop a mesh run mid-sweep, resume from the delta log, and land on
+    exactly the uninterrupted run's numbers (TLC -recover analog).  The
+    mesh now checkpoints the same way the single-device engine does: one
+    mdelta record per level, replayed from Init on resume."""
     cfg = CFGS[0]
     want = OracleChecker(cfg).run()
     mesh = make_mesh(4)
@@ -101,14 +103,23 @@ def test_sharded_checkpoint_resume(tmp_path):
         max_depth=4, checkpoint_dir=str(tmp_path),
     )
     assert half.depth == 4
+    assert len(list(tmp_path.glob("mdelta_*.npz"))) == 4
     res = ShardedChecker(cfg, mesh, cap_x=512, vcap=4096).run(
-        resume_from=str(tmp_path / "latest.npz"),
+        resume_from=str(tmp_path), checkpoint_dir=str(tmp_path),
     )
     assert res.ok == want.ok
     assert res.distinct == want.distinct
     assert res.generated == want.generated
     assert res.depth == want.depth
     assert res.level_sizes == want.level_sizes
+    # the resumed run kept appending to the same chain; a second full
+    # replay of the whole log reproduces the run state again
+    assert len(list(tmp_path.glob("mdelta_*.npz"))) == want.depth
+    res2 = ShardedChecker(cfg, mesh, cap_x=512, vcap=4096).run(
+        resume_from=str(tmp_path),
+    )
+    assert res2.distinct == want.distinct
+    assert res2.level_sizes == want.level_sizes
 
 
 def test_sharded_checkpoint_rejects_mesh_mismatch(tmp_path):
@@ -118,5 +129,14 @@ def test_sharded_checkpoint_rejects_mesh_mismatch(tmp_path):
     )
     with pytest.raises(ValueError, match="4-device mesh"):
         ShardedChecker(cfg, make_mesh(2), cap_x=512, vcap=4096).run(
-            resume_from=str(tmp_path / "latest.npz"),
+            resume_from=str(tmp_path),
+        )
+    with pytest.raises(ValueError, match="exchange mode"):
+        ShardedChecker(
+            cfg, make_mesh(4), cap_x=512, vcap=4096, exchange="all_gather",
+        ).run(resume_from=str(tmp_path))
+    # a fresh run must refuse to interleave into an existing log
+    with pytest.raises(ValueError, match="previous"):
+        ShardedChecker(cfg, make_mesh(4), cap_x=512, vcap=4096).run(
+            max_depth=2, checkpoint_dir=str(tmp_path),
         )

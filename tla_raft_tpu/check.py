@@ -132,13 +132,13 @@ def main(argv=None) -> int:
                         "(TLC's states/ spill analog) rooted at this dir")
     p.add_argument("--checkpoint-dir", default=None,
                    help="write per-level delta-log checkpoints here "
-                        "(single-device; the mesh backend writes a "
-                        "latest.npz monolith)")
+                        "(both backends; the single-device external-store "
+                        "path also spills per-group partial records "
+                        "inside a level)")
     p.add_argument("--checkpoint-every", type=int, default=1,
-                   help="single-device: 0 disables checkpointing, any "
-                        "other value records EVERY level (the delta-log "
-                        "replay chain cannot skip levels); mesh: save the "
-                        "monolith every N levels")
+                   help="0 disables checkpointing; any other value "
+                        "records EVERY level (the delta-log replay chain "
+                        "cannot skip levels)")
     p.add_argument("--recover", default=None,
                    help="resume from a checkpoint: the --checkpoint-dir "
                         "directory (delta log) or a monolith .npz")

@@ -1000,21 +1000,26 @@ class JaxChecker:
                 jnp.concatenate(cvs), jnp.concatenate(cfs),
                 jnp.concatenate(cps),
             )
-            n_u, ab, ovf_h, mult_g = jax.device_get(
-                (n_u_dev, abort_at, overflow, mult_acc)
+            # fetch the FIXED-shape padded buffers and slice host-side:
+            # a device-side gv[:n_u] slice would compile a fresh tiny
+            # program per distinct n_u — one remote compile per group on
+            # a tunneled backend, each a hang/crash opportunity — for a
+            # bandwidth saving of ~6% of the group fetch
+            n_u, ab, ovf_h, mult_g, gv_np, gf_np, gp_np = jax.device_get(
+                (n_u_dev, abort_at, overflow, mult_acc, gv, gf, gp)
             )
             mult_np += np.asarray(mult_g, np.int64)
             if int(ab) < n_f or bool(ovf_h):
                 # abort (split-brain) or cap_x overflow: nothing reached
                 # the store yet, so run() can report the trace / grow the
-                # budget and redo the level cleanly (a redo's changed
-                # cap_x also invalidates this level's partials — the meta
-                # check drops them)
+                # budget and redo the level cleanly.  Completed groups'
+                # partials survive the redo — their candidate sets are
+                # budget-independent (see _load_partials)
                 return (0, None, None, int(ab), bool(ovf_h), False, mult_np)
             n_u = int(n_u)
-            gv_np = np.asarray(gv[:n_u])
-            gf_np = np.asarray(gf[:n_u])
-            gp_np = np.asarray(gp[:n_u])
+            gv_np = np.asarray(gv_np[:n_u])
+            gf_np = np.asarray(gf_np[:n_u])
+            gp_np = np.asarray(gp_np[:n_u])
             hv.append(gv_np)
             hf.append(gf_np)
             hp.append(gp_np)
@@ -1065,9 +1070,14 @@ class JaxChecker:
             try:
                 z = np.load(f)
                 meta = tuple(int(x) for x in z["meta"])
-                want = (level, meta[1], self.chunk, self.cap_x, self.G,
-                        self.K, n_f)
-                if level is None or meta != want:
+                # cap_x (meta[3]) deliberately does NOT participate in the
+                # match: a saved group's candidate set is budget-
+                # independent (its chunks passed the overflow check before
+                # the save), so a cap_x-growth redo of the level keeps
+                # every completed group instead of re-expanding it
+                want = (level, meta[1], self.chunk, self.G, self.K, n_f)
+                got = (meta[0], meta[1], meta[2], meta[4], meta[5], meta[6])
+                if level is None or got != want:
                     os.unlink(f)
                     continue
                 rec = dict(

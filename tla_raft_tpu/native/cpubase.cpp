@@ -729,6 +729,7 @@ int main(int argc, char **argv) {
   if (argc > 4) cfg.maxR = std::atoi(argv[4]);
   if (argc > 5) max_depth = std::atoi(argv[5]);
   if (argc > 6) n_threads = std::atoi(argv[6]);
+  if (n_threads < 1) n_threads = 1;  // hardware_concurrency() may be 0
   // compile-time caps: MAXS servers, MAXL log entries, and the packed
   // message fields (term/index fields are 4 bits, vals 3)
   if (cfg.S > MAXS || cfg.V + 1 > MAXL || cfg.maxE > 15 || cfg.V > 7) {

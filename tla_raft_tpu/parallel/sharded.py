@@ -412,34 +412,174 @@ class ShardedChecker:
         return {k: v for k, v in out.items() if v}
 
     # -- checkpoint / resume (TLC's states/ + -recover, mesh edition) ------
+    #
+    # Two formats, mirroring the single-device engine (engine/bfs.py):
+    #
+    # * **delta log** (``mdelta_####.npz``, the default): each level
+    #   appends only its compact (parent-layout-index, slot) pairs plus
+    #   per-device winner counts — resume REPLAYS the materialize pass
+    #   from Init and recomputes fingerprints, so nothing store-sized is
+    #   ever written.  Records are device-layout-relative and pinned to
+    #   (D, exchange, canon) in their meta.
+    #
+    # * **monolith** (``latest.npz``, back-compat): full frontier + store
+    #   in one file.
 
-    def _save_checkpoint(self, path, frontier, msum, n_f, visited, distinct,
-                         generated, depth, level_sizes, trace_levels,
-                         mult_slots_total):
-        arrs = {f"st_{k}": np.asarray(v) for k, v in frontier._asdict().items()}
-        for i, (p, s) in enumerate(trace_levels):
-            arrs[f"trace_p{i}"] = p
-            arrs[f"trace_s{i}"] = s
-        tmp = f"{path}.tmp.npz"
-        np.savez_compressed(
+    def _save_mdelta(self, ckdir, depth, out, cap_f):
+        """Append one level's delta record (compact layout prefixes)."""
+        os.makedirs(ckdir, exist_ok=True)
+        gpidx = np.asarray(out.gpidx).astype(np.int64)
+        slots = np.asarray(out.slots).astype(np.int64)
+        n_local = np.asarray(out.n_new_local).astype(np.int64).reshape(-1)
+        valid = gpidx >= 0
+        cap_c = gpidx.shape[0] // self.D
+        # winners are compacted to each device block's prefix (_compact),
+        # so the valid mask must equal the per-device prefix counts
+        assert valid.reshape(self.D, cap_c).sum(1).tolist() == n_local.tolist()
+        slot_dt = np.uint16 if self.K <= 0xFFFF else np.uint32
+        tmp = os.path.join(ckdir, f".tmp_mdelta_{depth:04d}.npz")
+        np.savez(
             tmp,
-            msum=np.asarray(msum),
-            n_f=np.asarray(n_f),
-            visited=np.asarray(visited),
-            mult_slots=mult_slots_total,
+            pidx=gpidx[valid].astype(np.uint32),
+            slot=slots[valid].astype(slot_dt),
+            n_local=n_local,
+            mult=np.asarray(out.mult_slots, np.int64),
             meta=np.asarray(
-                [self.D, distinct, generated, depth,
+                [depth, int(valid.sum()), self.D, cap_f, cap_c,
                  1 if self.exchange == "all_to_all" else 0,
                  1 if self.canon == "late" else 0],
                 np.int64,
             ),
-            level_sizes=np.asarray(level_sizes, np.int64),
-            n_trace=np.asarray([len(trace_levels)], np.int64),
-            **arrs,
         )
-        os.replace(tmp, path)
+        os.replace(tmp, os.path.join(ckdir, f"mdelta_{depth:04d}.npz"))
+
+    def _resume_from_mdeltas(self, ckdir, shard, repl):
+        """Rebuild the mesh run state by replaying the delta log from Init.
+
+        The replay materializes each level's (parent, slot) record with
+        the shared successor kernel and recomputes canonical fingerprints
+        — minutes of compute instead of a store-sized monolith read, and
+        the rebuilt store holds exactly what an uninterrupted run's would
+        (fp %% D shards for all_to_all, a sorted replicated array for
+        all_gather)."""
+        import glob
+
+        files = sorted(glob.glob(os.path.join(ckdir, "mdelta_*.npz")))
+        if not files:
+            raise ValueError(f"no mdelta_*.npz checkpoints under {ckdir}")
+        cfg, K, D = self.cfg, self.K, self.D
+        frontier = init_batch(cfg, D)  # layout [D, cap_f=1]
+        fv0, _ff0, _ms0 = self.fpr.state_fingerprints(
+            jax.tree.map(lambda x: x[:1], frontier)
+        )
+        fps_all = [np.asarray(fv0.astype(U64))]
+        trace_levels, level_sizes = [], [1]
+        mult_slots_total = np.zeros(K, np.int64)
+        depth = 0
+        n_local = np.array([1] + [0] * (D - 1), np.int64)
+        for f in files:
+            z = np.load(f)
+            meta = [int(x) for x in z["meta"]]
+            d, n_new, Dz, cap_f, cap_c, a2a, late = meta
+            if d != depth + 1:
+                raise ValueError(
+                    f"mdelta log gap: expected level {depth + 1}, found "
+                    f"level {d} ({f})"
+                )
+            if Dz != D:
+                raise ValueError(
+                    f"checkpoint was taken on a {Dz}-device mesh, this "
+                    f"run has {D}"
+                )
+            if a2a != (1 if self.exchange == "all_to_all" else 0):
+                raise ValueError(
+                    "checkpoint exchange mode differs from this run"
+                )
+            if late != (1 if self.canon == "late" else 0):
+                raise ValueError(
+                    "checkpoint canonicalization mode differs from this "
+                    "run (pass the matching --canon)"
+                )
+            if cap_f * D != int(frontier.voted_for.shape[0]):
+                raise ValueError(
+                    f"mdelta level {d} expects a {cap_f}-wide frontier, "
+                    f"replay built {frontier.voted_for.shape[0] // D}"
+                )
+            nl = z["n_local"].astype(np.int64)
+            # rebuild the padded device layout from the compact prefixes
+            gpidx = np.full(D * cap_c, -1, np.int64)
+            slots = np.zeros(D * cap_c, np.int64)
+            off = 0
+            for dev in range(D):
+                c = int(nl[dev])
+                gpidx[dev * cap_c : dev * cap_c + c] = z["pidx"][off : off + c]
+                slots[dev * cap_c : dev * cap_c + c] = z["slot"][off : off + c]
+                off += c
+            valid = gpidx >= 0
+            parents = jax.tree.map(
+                lambda x: x[jnp.asarray(np.clip(gpidx, 0, None))], frontier
+            )
+            children = self.kern.materialize(parents, jnp.asarray(slots, I64))
+            vmask = jnp.asarray(valid)
+            children = jax.tree.map(
+                lambda x: jnp.where(
+                    vmask.reshape((-1,) + (1,) * (x.ndim - 1)),
+                    x, jnp.zeros_like(x),
+                ),
+                children,
+            )
+            fv, _ff, _ms = self.fpr.state_fingerprints(children)
+            fps_all.append(np.asarray(fv.astype(U64))[valid])
+            trace_levels.append((gpidx, slots))
+            level_sizes.append(n_new)
+            mult_slots_total = mult_slots_total + z["mult"].astype(np.int64)
+            frontier = children
+            n_local = nl
+            depth = d
+        distinct = int(sum(level_sizes))
+        fps = np.unique(np.concatenate(fps_all))
+        if len(fps) != distinct:
+            raise ValueError(
+                f"mdelta replay rebuilt {len(fps)} distinct fingerprints "
+                f"for {distinct} recorded states — corrupt or mixed log"
+            )
+        if self.exchange == "all_to_all":
+            per_shard = [np.sort(fps[fps % np.uint64(D) == o]) for o in range(D)]
+            need = max(len(s) for s in per_shard)
+            vcap = max(self.vcap, 1 << (2 * need - 1).bit_length())
+            vis = np.full((D, vcap), np.uint64(0xFFFFFFFFFFFFFFFF))
+            for o, s in enumerate(per_shard):
+                vis[o, : len(s)] = s
+            vis = np.sort(vis, axis=1)
+            self.vcap = vcap
+            visited = jax.device_put(jnp.asarray(vis).reshape(-1), shard)
+        else:
+            vcap = max(self.vcap, 1 << (2 * len(fps) - 1).bit_length())
+            vis = np.full(vcap, np.uint64(0xFFFFFFFFFFFFFFFF))
+            vis[: len(fps)] = fps
+            self.vcap = vcap
+            visited = jax.device_put(jnp.asarray(np.sort(vis)), repl)
+        msum = (
+            self.fpr.msg_hash(frontier.msgs)
+            if self.canon == "expand"
+            else jnp.zeros((frontier.voted_for.shape[0], 1, 1), jnp.uint32)
+        )
+        return dict(
+            frontier=jax.device_put(frontier, shard),
+            msum=jax.device_put(msum, shard),
+            n_f=jax.device_put(jnp.asarray(n_local, I64), shard),
+            visited=visited,
+            distinct=distinct,
+            generated=int(mult_slots_total.sum()),
+            depth=depth,
+            level_sizes=level_sizes,
+            trace_levels=trace_levels,
+            mult_slots=mult_slots_total,
+        )
 
     def _load_checkpoint(self, path, shard, repl):
+        """Read a legacy ``latest.npz`` monolith (writer removed — the
+        delta log replaced it; kept so old checkpoints stay resumable)."""
         z = np.load(path)
         meta = [int(x) for x in z["meta"]]
         D, distinct, generated, depth, a2a = meta[:5]
@@ -505,8 +645,20 @@ class ShardedChecker:
         repl = NamedSharding(mesh, P())
         t0 = time.monotonic()
 
+        if checkpoint_dir and checkpoint_every and resume_from is None:
+            import glob as _glob
+
+            if _glob.glob(os.path.join(checkpoint_dir, "mdelta_*.npz")):
+                raise ValueError(
+                    f"{checkpoint_dir} holds checkpoints from a previous "
+                    "run; a fresh run would interleave two runs' logs — "
+                    "resume with --recover or clear the directory"
+                )
         if resume_from is not None:
-            ck = self._load_checkpoint(resume_from, shard, repl)
+            if os.path.isdir(resume_from):
+                ck = self._resume_from_mdeltas(resume_from, shard, repl)
+            else:
+                ck = self._load_checkpoint(resume_from, shard, repl)
             frontier, msum, n_f = ck["frontier"], ck["msum"], ck["n_f"]
             visited = ck["visited"]
             distinct, generated, depth = (
@@ -605,6 +757,7 @@ class ShardedChecker:
             n_new = int(out.n_new_total)
             if n_new == 0:
                 break
+            cap_f_prev = frontier.voted_for.shape[0] // D
             distinct += n_new
             level_sizes.append(n_new)
             depth += 1
@@ -652,14 +805,11 @@ class ShardedChecker:
                     (f"Invariant {name} is violated", trace),
                 )
             # checkpoint only invariant-clean levels (a resumed run never
-            # re-checks the loaded frontier)
-            if checkpoint_dir and checkpoint_every and depth % checkpoint_every == 0:
-                os.makedirs(checkpoint_dir, exist_ok=True)
-                self._save_checkpoint(
-                    os.path.join(checkpoint_dir, "latest.npz"), frontier, msum,
-                    n_f, visited, distinct, generated, depth, level_sizes,
-                    trace_levels, mult_slots_total,
-                )
+            # re-checks the loaded frontier).  Delta-log format: the
+            # replay chain needs EVERY level, so checkpoint_every only
+            # gates whether checkpointing happens at all.
+            if checkpoint_dir and checkpoint_every:
+                self._save_mdelta(checkpoint_dir, depth, out, cap_f_prev)
 
         return CheckResult(
             True, distinct, generated, depth, tuple(level_sizes), None,

@@ -241,8 +241,11 @@ if __name__ == "__main__":
             print(f"    {name!r}: {_def_hash(body)!r},")
         print("}")
     else:
+        rc = 0
         for spec in sys.argv[1:]:
             probs = validate_spec(spec)
             print(f"{spec}: {'OK' if not probs else ''}")
             for pr in probs:
                 print(f"  {pr}")
+            rc = rc or (1 if probs else 0)
+        sys.exit(rc)
