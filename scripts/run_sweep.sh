@@ -13,6 +13,11 @@ set -u
 cd "$(dirname "$0")/.."
 CHUNK="${1:-8192}"
 CANON="${2:-late}"
+# deep levels live near the HBM ceiling: let XLA use (almost) all of it
+export XLA_PYTHON_CLIENT_MEM_FRACTION="${XLA_PYTHON_CLIENT_MEM_FRACTION:-0.92}"
+# message-set widths saturate at 96 on this family; start with headroom so
+# cap_m growth (which can't fire after parent segments are freed) never does
+export TLA_RAFT_CAP_M="${TLA_RAFT_CAP_M:-104}"
 CKDIR=states_delta
 TRIES=0
 MAX_TRIES=40

@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
 import time
 from typing import Callable, NamedTuple
 
@@ -114,6 +115,32 @@ _CORE_FIELDS = [f for f in RaftState._fields if f != "msgs"]
 
 def _pow2(n: int) -> int:
     return 1 << max(0, (n - 1)).bit_length()
+
+
+def _pick_segments(cap_f: int, sl: int, max_seg: int = 8) -> int:
+    """Segment count for a frontier of capacity cap_f (external-store
+    path): the largest power of two <= max_seg that divides cap_f into
+    slice-aligned segments.  Segments are the unit of progressive parent
+    freeing during materialization — the reason the deep sweep's peak is
+    ~dst + one segment instead of dst + whole parent."""
+    n = max_seg
+    while n > 1 and (cap_f % n or (cap_f // n) % sl):
+        n //= 2
+    return n
+
+
+def _cap_steps(n: int) -> int:
+    """Smallest c >= n with c in {2^k, 3*2^(k-1)} — frontier capacities.
+
+    Pure pow2 quantization wastes up to 50% of HBM in padding; at the
+    deep-sweep frontiers (tens of GB) that waste IS the memory wall, so
+    frontiers quantize on half-steps (~17% max waste) at the cost of at
+    most one extra compiled shape per magnitude.  Callers must still
+    enforce divisibility by their chunk (a half-step 3*2^(k-1) is only a
+    chunk multiple when 2^(k-1) >= chunk — see _frontier_cap)."""
+    p = _pow2(n)
+    half = 3 * (p >> 2)
+    return half if half >= n and half > 0 else p
 
 
 @functools.lru_cache(maxsize=1)
@@ -295,6 +322,19 @@ def _merge_sorted(visited, new_fps):
     return jnp.sort(jnp.concatenate([visited, new_fps]))
 
 
+@functools.partial(jax.jit, donate_argnums=0)
+def _write_slice(dst, part, start):
+    """Donated in-place write of one materialized slice into the new
+    frontier.  The old parts-list + concat scheme held every slice AND
+    both frontier copies live at once — at the 16M-state levels of the
+    reference sweep that peak OOMed the 16 GB HBM (round 3); donation
+    keeps the build at one destination + one slice."""
+    return jax.tree.map(
+        lambda d, p: jax.lax.dynamic_update_slice_in_dim(d, p, start, 0),
+        dst, part,
+    )
+
+
 class JaxChecker:
     """The TPU model checker for one RaftConfig.
 
@@ -330,8 +370,16 @@ class JaxChecker:
         self.K = self.kern.K
         self.uni_words = self.kern.uni.n_words
         # sparse-frontier width: max message-set size per reachable state
-        # (grows ~1/level; overflow auto-doubles it and re-materializes
-        # the level — see _materialize_grow)
+        # (grows ~1/level, saturating near a structural bound — 96 on the
+        # reference family; overflow auto-grows it and re-materializes
+        # the level, see _materialize_grow).  TLA_RAFT_CAP_M overrides —
+        # deep sweeps start with headroom so growth never fires after
+        # parent segments are released.
+        env_capm = os.environ.get("TLA_RAFT_CAP_M")
+        if env_capm is not None and cap_m == 96:
+            # env overrides only the DEFAULT: a caller passing an explicit
+            # cap_m (tests bounding HBM, the growth suite) keeps it
+            cap_m = int(env_capm)
         self.cap_m = min(cap_m, self.kern.uni.M)
         self.id_dtype = jnp.int16 if self.kern.uni.M < (1 << 15) else jnp.int32
         if chunk & (chunk - 1):
@@ -368,6 +416,7 @@ class JaxChecker:
             (n, resolve_invariant_kernel(n)) for n in cfg.invariants
         ]
         self._mat_slice = jax.jit(self._mat_slice_impl)
+        self._mat_slice_seg = jax.jit(self._mat_slice_seg_impl)
         self._expand_chunk = jax.jit(self._expand_chunk_impl)
         self._inv_scan = jax.jit(self._inv_scan_impl)
 
@@ -425,6 +474,34 @@ class JaxChecker:
         pidx = (pay // K).astype(I32)
         slots = pay % K
         parents_c = jax.tree.map(lambda x: x[jnp.clip(pidx, 0, None)], frontier)
+        parents = self._inflate(parents_c)
+        children = self.kern.materialize(parents, slots)
+        child_f, ovf_rows = self._deflate(children)
+        in_range = jnp.arange(ovf_rows.shape[0], dtype=I64) < n_valid
+        bad_at = self._inv_scan_impl(children, n_valid)
+        return child_f, bad_at, (ovf_rows & in_range).any()
+
+    def _mat_slice_seg_impl(self, seg_a: Frontier, seg_b: Frontier, base,
+                            pay, n_valid):
+        """_mat_slice over a two-segment parent window (external-store
+        path).  Payload-sorted slices touch a narrow parent range, so a
+        (segment j, segment j+1) window always covers one slice; parents
+        gather from whichever side of the boundary they fall on.  With a
+        single-segment frontier the window is (seg, seg) and the where
+        collapses to a plain gather."""
+        K = self.K
+        L = seg_a.voted_for.shape[0]
+        pidx = (pay // K).astype(I64) - base
+        slots = pay % K
+        lo = jnp.clip(pidx, 0, L - 1).astype(I32)
+        hi = jnp.clip(pidx - L, 0, L - 1).astype(I32)
+        in_a = pidx < L
+        parents_c = jax.tree.map(
+            lambda a, b: jnp.where(
+                in_a.reshape((-1,) + (1,) * (a.ndim - 1)), a[lo], b[hi]
+            ),
+            seg_a, seg_b,
+        )
         parents = self._inflate(parents_c)
         children = self.kern.materialize(parents, slots)
         child_f, ovf_rows = self._deflate(children)
@@ -569,22 +646,130 @@ class JaxChecker:
         os.replace(tmp, os.path.join(ckdir, f"delta_{depth:04d}.npz"))
 
     def _materialize_payload_slices(self, frontier, new_payload, n_new):
-        """Run _mat_slice over every survivor slice; returns the parts."""
+        """Run _mat_slice over every survivor slice.
+
+        Returns (child_frontier_or_parts, bad_ds, ovf_ds, n_slices, sl,
+        built) — when the slice tiling fits the pow2 target capacity
+        (every deep level), slices are written straight into a
+        preallocated destination frontier with donated in-place updates
+        (``built=True``, first element is the complete new frontier);
+        tiny levels whose slice width exceeds the target keep the
+        parts-list path (``built=False``, caller concatenates+truncates).
+        """
         sl = min(4 * self.chunk, new_payload.shape[0])
-        child_parts, bad_ds, ovf_ds = [], [], []
         n_slices = -(-n_new // sl)
+        cap_f = self._frontier_cap(n_new)
+        built = n_slices * sl <= cap_f
+        dst = None
+        child_parts, bad_ds, ovf_ds = [], [], []
         for si in range(n_slices):
             take = min(sl, n_new - si * sl)
             pay_slice = jax.lax.dynamic_slice_in_dim(new_payload, si * sl, sl)
             ch_f, bad_d, ovf_d = self._mat_slice(
                 frontier, pay_slice, jnp.asarray(take, I64)
             )
-            child_parts.append(ch_f)
+            if built:
+                if dst is None:
+                    # template from the SLICE output, not the parent — the
+                    # parent may carry a different (e.g. checkpointed-era)
+                    # cap_m width than the children deflate to
+                    dst = jax.tree.map(
+                        lambda x: jnp.zeros((cap_f, *x.shape[1:]), x.dtype),
+                        ch_f,
+                    )
+                dst = _write_slice(dst, ch_f, jnp.asarray(si * sl, I32))
+            else:
+                child_parts.append(ch_f)
             bad_ds.append(bad_d)
             ovf_ds.append(ovf_d)
             if si % 4 == 3:
                 jax.device_get(bad_d)  # bound the dispatch queue
-        return child_parts, bad_ds, ovf_ds, n_slices, sl
+        return (dst if built else child_parts, bad_ds, ovf_ds, n_slices, sl,
+                built)
+
+    def _frontier_cap(self, n: int) -> int:
+        """Frontier capacity for n states: half-step quantized, but ONLY
+        when the step divides evenly into chunks — the chunked expand
+        carves the frontier with dynamic slices at chunk strides, and a
+        non-multiple capacity would silently clamp the last slice onto
+        re-read rows (wrong parents)."""
+        c = _cap_steps(n)
+        if c % self.chunk:
+            c = _pow2(n)
+        return max(c, self.chunk)
+
+    def _materialize_segs(self, segs, pay_np, new_payload, n_new):
+        """Segment-streamed materialize for the external-store path.
+
+        Parents arrive as a list of equal-size segment buffers; payloads
+        are sorted ascending (payload = pidx*K + slot), so consecutive
+        slices walk the parent segments left to right: each slice
+        gathers from a (j, j+1) window and every segment left of the
+        window frees as soon as the walk passes it — the INPUT LIST IS
+        MUTATED (entries set to None) so every holder drops the buffer.
+        Children land in segmented destinations, allocated as the walk
+        reaches them.  HBM peak ~ dst + the unconsumed parent tail,
+        instead of whole parent + whole dst — the wall the reference
+        sweep hit at its level-27 materialize (13+ GB of 14.7 usable).
+
+        Returns (dst_segs, bad_ds, ovf_ds, n_slices, sl), or None when a
+        precondition fails (a slice spanning more than two segments —
+        practically impossible for payload-sorted deep levels — or a
+        legacy record whose payloads aren't ascending, or slice tiling
+        that doesn't fit the capacity): the caller then takes the
+        window-less whole-parent path.
+        """
+        K = self.K
+        sl = min(4 * self.chunk, new_payload.shape[0])
+        n_slices = -(-n_new // sl)
+        cap_f = self._frontier_cap(n_new)
+        if n_slices * sl > cap_f:
+            return None
+        # the window reasoning below is sound only for globally ascending
+        # payloads — endpoint checks alone would let a legacy cv-ordered
+        # record slip interior payloads outside the window, where the
+        # gather clips onto WRONG PARENT ROWS with no error
+        if not bool(np.all(np.diff(pay_np[:n_new].astype(np.int64)) > 0)):
+            return None
+        L = segs[0].voted_for.shape[0]
+        n_par = len(segs)
+        j_los = []
+        for si in range(n_slices):
+            a, b = si * sl, min(si * sl + sl, n_new)
+            p_lo = int(pay_np[a]) // K
+            p_hi = int(pay_np[b - 1]) // K
+            j_lo = min(p_lo // L, n_par - 1)
+            if p_hi >= min(j_lo + 2, n_par) * L:
+                return None  # parent span exceeds the 2-segment window
+            j_los.append(j_lo)
+        n_seg_d = _pick_segments(cap_f, sl)
+        seg_d = cap_f // n_seg_d
+        dst = [None] * n_seg_d
+        bad_ds, ovf_ds = [], []
+        for si in range(n_slices):
+            take = min(sl, n_new - si * sl)
+            j = j_los[si]
+            pay_slice = jax.lax.dynamic_slice_in_dim(new_payload, si * sl, sl)
+            part, bad_d, ovf_d = self._mat_slice_seg(
+                segs[j], segs[min(j + 1, n_par - 1)],
+                jnp.asarray(j * L, I64), pay_slice, jnp.asarray(take, I64),
+            )
+            dj, off = divmod(si * sl, seg_d)
+            if dst[dj] is None:
+                dst[dj] = jax.tree.map(
+                    lambda x: jnp.zeros((seg_d, *x.shape[1:]), x.dtype), part
+                )
+            dst[dj] = _write_slice(dst[dj], part, jnp.asarray(off, I32))
+            for k in range(j):  # the walk has passed these parents for good
+                segs[k] = None
+            bad_ds.append(bad_d)
+            ovf_ds.append(ovf_d)
+            if si % 4 == 3:
+                jax.device_get(bad_d)
+        for dj in range(n_seg_d):  # untouched capacity tail
+            if dst[dj] is None:
+                dst[dj] = jax.tree.map(jnp.zeros_like, dst[0])
+        return dst, bad_ds, ovf_ds, n_slices, sl
 
     def _widen_msg_ids(self, frontier: Frontier) -> Frontier:
         """Pad the frontier's sparse message-id lanes out to self.cap_m."""
@@ -598,33 +783,98 @@ class JaxChecker:
             )
         )
 
-    def _materialize_grow(self, frontier, new_payload, n_new):
+    def _materialize_grow(self, frontier, new_payload, n_new, pay_np=None):
         """Materialize survivors, auto-growing cap_m on overflow.
 
         cap_m (the sparse-frontier message-set width) grows ~1 per BFS
         level on the reference family; a fixed budget would make deep
         sweeps die hours in (VERDICT round 2, weak #6).  Overflow is
         detected per slice by ``_msgs_to_ids``; the payloads are already
-        known, so doubling the width, widening the (parent) frontier's id
+        known, so growing the width, widening the (parent) frontier's id
         lanes and re-materializing the level is pure re-computation —
-        the same recovery shape as the cap_x growth redo.  Returns
-        (child_parts, bads, n_slices, sl, frontier) with the possibly-
-        widened frontier.
+        the same recovery shape as the cap_x growth redo.  EXCEPT on the
+        segment-streamed path, where consumed parents are already freed:
+        there overflow raises, and a restart with TLA_RAFT_CAP_M set
+        resumes from the delta log (widths saturate at 96 on the
+        reference family, so with the default headroom this is
+        unreachable in practice).
+
+        The host-store path passes ``frontier`` as a segment list (and
+        ``pay_np``, the host-side sorted payloads); the result is then a
+        segment list too.  Returns (new_frontier, bads, n_slices, sl,
+        parent) — the new frontier is at its _frontier_cap capacity.
         """
-        while True:
-            parts, bad_ds, ovf_ds, n_slices, sl = (
-                self._materialize_payload_slices(frontier, new_payload, n_new)
+        def concat_pad(parts):
+            cap_f = self._frontier_cap(n_new)
+            return jax.tree.map(
+                lambda *xs: _pad_axis0(jnp.concatenate(xs), cap_f), *parts
             )
+
+        while True:
+            segged = False
+            retry_parent = None
+            if isinstance(frontier, list):
+                res = (
+                    self._materialize_segs(frontier, pay_np, new_payload,
+                                           n_new)
+                    if pay_np is not None
+                    else None
+                )
+                if res is not None:
+                    out, bad_ds, ovf_ds, n_slices, sl = res
+                    segged = True
+                else:
+                    whole = (
+                        frontier[0]
+                        if len(frontier) == 1
+                        else jax.tree.map(
+                            lambda *xs: jnp.concatenate(xs), *frontier
+                        )
+                    )
+                    out, bad_ds, ovf_ds, n_slices, sl, built = (
+                        self._materialize_payload_slices(
+                            whole, new_payload, n_new
+                        )
+                    )
+                    out = [out if built else concat_pad(out)]
+                    retry_parent = whole
+            else:
+                out, bad_ds, ovf_ds, n_slices, sl, built = (
+                    self._materialize_payload_slices(
+                        frontier, new_payload, n_new
+                    )
+                )
+                if not built:
+                    out = concat_pad(out)
+                retry_parent = frontier
             bads, ovfs = jax.device_get((bad_ds, ovf_ds))
             if not any(bool(np.asarray(o)) for o in ovfs):
-                return parts, bads, n_slices, sl, frontier
+                return out, bads, n_slices, sl, frontier
             if self.cap_m >= self.kern.uni.M:
                 raise RuntimeError(
                     "message-set width exceeds the whole universe — "
                     "corrupt payloads?"
                 )
-            self.cap_m = min(2 * self.cap_m, self.kern.uni.M)
-            frontier = self._widen_msg_ids(frontier)
+            if segged and any(s is None for s in frontier):
+                # only unrecoverable once the walk actually released
+                # parent segments; a restart with TLA_RAFT_CAP_M set
+                # resumes from the delta log
+                raise RuntimeError(
+                    f"cap_m={self.cap_m} overflowed after parent segments "
+                    "were released; restart with TLA_RAFT_CAP_M="
+                    f"{self.cap_m + 32} — the delta log resumes the run"
+                )
+            # widths grow ~1/level on this spec family and saturate near
+            # the structural bound (measured 96 at depth 22 of the
+            # reference sweep), so grow in small steps: doubling 96->192
+            # doubles every deep frontier's bytes for ~10 lanes of need
+            self.cap_m = min(self.cap_m + 32, self.kern.uni.M)
+            print(f"[engine] cap_m overflow: growing to {self.cap_m} and "
+                  f"re-materializing the level", file=sys.stderr)
+            if isinstance(frontier, list):
+                frontier = [self._widen_msg_ids(s) for s in frontier]
+            else:
+                frontier = self._widen_msg_ids(retry_parent)
 
     def _resume_from_deltas(self, ckdir):
         """Rebuild the run state by replaying the delta log.
@@ -662,6 +912,7 @@ class JaxChecker:
                 # their location differs.
                 self._seed_host_store(visited_base)
                 visited_base = None
+                frontier = [frontier]  # host-path frontiers are seg lists
             fps_parts = []
             trace_levels = ck["trace_levels"]
             level_sizes = list(ck["level_sizes"])
@@ -674,6 +925,8 @@ class JaxChecker:
             frontier = jax.tree.map(
                 lambda x: _pad_axis0(x, self.chunk), frontier
             )
+            if self.host_store is not None:
+                frontier = [frontier]
             n_f = 1
             visited_base = None
             init_fps = np.asarray(fv0.astype(U64))
@@ -698,14 +951,11 @@ class JaxChecker:
             payload_np = pidx * K + slot
             cap = max(_pow2(n_new), 4 * self.chunk)
             new_payload = _pad_axis0(jnp.asarray(payload_np, I64), cap)
-            parts, _bads, _ns, _sl, frontier = self._materialize_grow(
-                frontier, new_payload, n_new
+            frontier, _bads, _ns, _sl, _parent = self._materialize_grow(
+                frontier, new_payload, n_new,
+                pay_np=payload_np if self.host_store is not None else None,
             )
-            cap_f = max(_pow2(n_new), self.chunk)
-            frontier = None  # drop the parent copy before the concat
-            frontier = jax.tree.map(
-                lambda *xs: _pad_axis0(jnp.concatenate(xs), cap_f), *parts
-            )
+            del _parent  # the replay keeps only the new frontier alive
             n_f = n_new
             if self.host_store is not None:
                 self.host_store.insert(z["fps"])
@@ -946,11 +1196,15 @@ class JaxChecker:
     # the next group starts, so a mid-level crash costs one group, not the
     # level (TLC's mid-level ``states/`` queue spill analog; the level-23
     # corruption saga in BASELINE.md is the motivation).  Partials are
-    # self-validating (level, chunk, cap_x, G, K, n_f in the meta) — BFS
+    # self-validating (level, chunk, G, K, n_f in the meta; cap_x is
+    # recorded but deliberately not matched — see _load_partials) — BFS
     # determinism makes a matching partial's contents exact.
 
-    def _expand_level_host(self, frontier: Frontier, n_f, ckdir=None,
-                           depth=None):
+    def _expand_level_host(self, frontier, n_f, ckdir=None, depth=None):
+        # the host path's frontier is a LIST of segment buffers (len >= 1;
+        # see _materialize_segs); chunks never straddle segments (segment
+        # sizes are chunk multiples by construction)
+        seg_len = frontier[0].voted_for.shape[0]
         n_f_dev = jnp.asarray(n_f, I64)
         G = self.G
         n_chunks = -(-max(n_f, 1) // self.chunk)
@@ -973,11 +1227,12 @@ class JaxChecker:
             overflow = jnp.zeros((), bool)
             synced = 0
             for ci in range(gi * G, min((gi + 1) * G, n_chunks)):
+                sj, off = divmod(ci * self.chunk, seg_len)
                 part_f = jax.tree.map(
                     lambda x: jax.lax.dynamic_slice_in_dim(
-                        x, ci * self.chunk, self.chunk
+                        x, off, self.chunk
                     ),
-                    frontier,
+                    frontier[sj],
                 )
                 cv, cf, cp, mult_slots, ab_at, ovf = self._expand_chunk(
                     part_f, jnp.asarray(ci * self.chunk, I64), n_f_dev
@@ -1038,9 +1293,17 @@ class JaxChecker:
         first[1:] = sv[1:] != sv[:-1]
         uniq_v, uniq_p = sv[first], sp[first]
         is_new = self.host_store.insert(uniq_v)
-        new_fps = np.ascontiguousarray(uniq_v[is_new])
-        new_pay = np.ascontiguousarray(uniq_p[is_new])
-        return (len(new_fps), new_fps, new_pay, int(BIG), False, False,
+        new_fps = uniq_v[is_new]
+        new_pay = uniq_p[is_new]
+        # emit survivors in ASCENDING PAYLOAD order (payload = pidx*K+slot,
+        # unique, so a plain argsort is deterministic): the delta record,
+        # the trace spill and the frontier all share this order, and it is
+        # what lets the segment-streamed materialize walk the parent
+        # segments monotonically (the fps are no longer cv-sorted; nothing
+        # downstream relied on that)
+        o = np.argsort(new_pay)
+        return (len(new_fps), np.ascontiguousarray(new_fps[o]),
+                np.ascontiguousarray(new_pay[o]), int(BIG), False, False,
                 mult_np)
 
     def _save_partial(self, ckdir, level, gi, hv, hf, hp, mult, n_f):
@@ -1184,6 +1447,7 @@ class JaxChecker:
                     self.host_store.clear()
                     self._seed_host_store(ck.pop("visited"))
                     ck["visited"] = jnp.full((64,), SENT, U64)
+                    ck["frontier"] = [ck["frontier"]]
             frontier, visited = ck["frontier"], ck["visited"]
             n_f, distinct, generated = ck["n_f"], ck["distinct"], ck["generated"]
             depth, level_sizes, trace_levels = (
@@ -1223,12 +1487,28 @@ class JaxChecker:
                 raise RuntimeError(
                     f"initial state's message set exceeds cap_m={self.cap_m}"
                 )
+            if self.host_store is not None:
+                frontier = [frontier]
         # frontier capacity must be a chunk multiple for dynamic slicing
-        if frontier.voted_for.shape[0] % self.chunk:
+        # (segment lists are chunk-aligned by construction)
+        if (
+            not isinstance(frontier, list)
+            and frontier.voted_for.shape[0] % self.chunk
+        ):
             cap0 = -(-frontier.voted_for.shape[0] // self.chunk) * self.chunk
             frontier = jax.tree.map(
                 lambda x: _pad_axis0(x, cap0), frontier
             )
+        elif isinstance(frontier, list) and (
+            frontier[0].voted_for.shape[0] % self.chunk
+        ):
+            cap0 = (
+                -(-frontier[0].voted_for.shape[0] // self.chunk) * self.chunk
+            )
+            frontier = [
+                jax.tree.map(lambda x: _pad_axis0(x, cap0), s)
+                for s in frontier
+            ]
 
         while n_f > 0:
             if max_depth is not None and depth >= max_depth:
@@ -1270,19 +1550,31 @@ class JaxChecker:
             pay_host = None  # host-side payloads (external-store path)
             if self.host_store is not None and n_new:
                 # _expand_level_host already ran the store filter; its
-                # outputs are host-side numpy (fps cv-ascending + payloads)
+                # outputs are host-side numpy in ASCENDING PAYLOAD order
+                # (the load-bearing invariant of the segment-streamed
+                # materialize and of delta-record/trace correspondence)
                 fps_host, pay_host = new_fps, new_payload
                 new_payload = _pad_axis0(
                     jnp.asarray(pay_host), max(_pow2(n_new), 4 * self.chunk)
                 )
             if n_new == 0:
+                # the empty level's partials (saved during its expansion)
+                # have no delta record to supersede them — wipe here so a
+                # completed run leaves a clean directory
+                if self.host_store is not None and checkpoint_dir:
+                    self._wipe_partials(checkpoint_dir)
                 break
 
             # --- materialize the survivors (device-resident) ------------
             # slice width must not exceed the payload capacity (a custom
-            # cap_x < 4*chunk shrinks the dedup output below 4*chunk)
-            child_parts, bads, n_slices, sl, frontier = (
-                self._materialize_grow(frontier, new_payload, n_new)
+            # cap_x < 4*chunk shrinks the dedup output below 4*chunk).
+            # The new frontier comes back fully built at its quantized
+            # capacity (donated in-place slice writes — the parent, the
+            # slices AND the concat result never coexist)
+            new_frontier, bads, n_slices, sl, frontier = (
+                self._materialize_grow(
+                    frontier, new_payload, n_new, pay_np=pay_host
+                )
             )
             # trace spill: the external-store path already holds the
             # payloads host-side — no device round-trip there
@@ -1303,20 +1595,8 @@ class JaxChecker:
             for si, b in enumerate(bads):
                 if b >= 0:
                     bad_idx = si * sl + int(b)
-                    bad_slice, bad_local = child_parts[si], int(b)
                     break
-            # pow2-quantized capacity: _mat_slice and the expand slicing
-            # take the frontier as a traced input, so its shape must cycle
-            # through O(log) values per run, not one per level.  Drop the
-            # parent frontier first — at multi-million-state levels the
-            # old frontier, the child parts and the concatenated result
-            # would otherwise coexist (~3 copies of GB-scale buffers)
-            cap_f = max(_pow2(n_new), self.chunk)
-            frontier = None
-            frontier = jax.tree.map(
-                lambda *xs: _pad_axis0(jnp.concatenate(xs), cap_f),
-                *child_parts,
-            )
+            frontier = new_frontier
 
             # --- bookkeeping, store merge -------------------------------
             trace_levels.append((pidx_np, slot_np))
@@ -1344,11 +1624,17 @@ class JaxChecker:
                     )
                 )
             if bad_idx >= 0:
-                one = self._inflate(
-                    jax.tree.map(
-                        lambda x: x[bad_local : bad_local + 1], bad_slice
+                if isinstance(frontier, list):
+                    L0 = frontier[0].voted_for.shape[0]
+                    bseg, boff = divmod(bad_idx, L0)
+                    bad_tree = jax.tree.map(
+                        lambda x: x[boff : boff + 1], frontier[bseg]
                     )
-                )
+                else:
+                    bad_tree = jax.tree.map(
+                        lambda x: x[bad_idx : bad_idx + 1], frontier
+                    )
+                one = self._inflate(bad_tree)
                 name = self._bad_invariant_name(one, 0)
                 return CheckResult(
                     False, distinct, generated, depth, tuple(level_sizes),

@@ -645,14 +645,27 @@ class ShardedChecker:
         repl = NamedSharding(mesh, P())
         t0 = time.monotonic()
 
-        if checkpoint_dir and checkpoint_every and resume_from is None:
+        if checkpoint_dir and checkpoint_every:
             import glob as _glob
 
-            if _glob.glob(os.path.join(checkpoint_dir, "mdelta_*.npz")):
+            has_log = _glob.glob(os.path.join(checkpoint_dir, "mdelta_*.npz"))
+            if resume_from is None and has_log:
                 raise ValueError(
                     f"{checkpoint_dir} holds checkpoints from a previous "
                     "run; a fresh run would interleave two runs' logs — "
                     "resume with --recover or clear the directory"
+                )
+            if resume_from is not None and not os.path.isdir(resume_from):
+                # a monolith resumes at depth d > 0; appending mdelta
+                # records from level d+1 would leave a gapped (or, if the
+                # directory already holds another run's records,
+                # interleaved) chain that replay correctly rejects later —
+                # refuse up front
+                raise ValueError(
+                    "cannot append mdelta checkpoints while resuming from "
+                    "a monolith snapshot (the replay chain would start at "
+                    f"level {1}+gap); resume from the delta directory, or "
+                    "drop --checkpoint-dir for this run"
                 )
         if resume_from is not None:
             if os.path.isdir(resume_from):
