@@ -322,17 +322,12 @@ def _merge_sorted(visited, new_fps):
     return jnp.sort(jnp.concatenate([visited, new_fps]))
 
 
-@functools.partial(jax.jit, donate_argnums=0)
-def _write_slice(dst, part, start):
-    """Donated in-place write of one materialized slice into the new
-    frontier.  The old parts-list + concat scheme held every slice AND
-    both frontier copies live at once — at the 16M-state levels of the
-    reference sweep that peak OOMed the 16 GB HBM (round 3); donation
-    keeps the build at one destination + one slice."""
-    return jax.tree.map(
-        lambda d, p: jax.lax.dynamic_update_slice_in_dim(d, p, start, 0),
-        dst, part,
-    )
+# NOTE: an earlier revision built destination frontiers with donated
+# dynamic_update_slice writes; the tunneled backend silently ignores the
+# donation (the copy runs at HBM speed, so timing probes can't tell) and
+# the two destination copies OOMed the deep-sweep replay.  Destinations
+# are now built by SEGMENT-bounded concats — transient is 2 segments,
+# never 2 frontiers, with no reliance on donation semantics.
 
 
 class JaxChecker:
@@ -646,21 +641,15 @@ class JaxChecker:
         os.replace(tmp, os.path.join(ckdir, f"delta_{depth:04d}.npz"))
 
     def _materialize_payload_slices(self, frontier, new_payload, n_new):
-        """Run _mat_slice over every survivor slice.
+        """Run _mat_slice over every survivor slice; returns the parts.
 
-        Returns (child_frontier_or_parts, bad_ds, ovf_ds, n_slices, sl,
-        built) — when the slice tiling fits the pow2 target capacity
-        (every deep level), slices are written straight into a
-        preallocated destination frontier with donated in-place updates
-        (``built=True``, first element is the complete new frontier);
-        tiny levels whose slice width exceeds the target keep the
-        parts-list path (``built=False``, caller concatenates+truncates).
+        (The device-store path's builder: parts + one concat.  The
+        external-store path uses the segment-streamed builders instead —
+        _materialize_segs / _materialize_fallback_segs — whose transients
+        are segment-bounded.)
         """
         sl = min(4 * self.chunk, new_payload.shape[0])
         n_slices = -(-n_new // sl)
-        cap_f = self._frontier_cap(n_new)
-        built = n_slices * sl <= cap_f
-        dst = None
         child_parts, bad_ds, ovf_ds = [], [], []
         for si in range(n_slices):
             take = min(sl, n_new - si * sl)
@@ -668,24 +657,15 @@ class JaxChecker:
             ch_f, bad_d, ovf_d = self._mat_slice(
                 frontier, pay_slice, jnp.asarray(take, I64)
             )
-            if built:
-                if dst is None:
-                    # template from the SLICE output, not the parent — the
-                    # parent may carry a different (e.g. checkpointed-era)
-                    # cap_m width than the children deflate to
-                    dst = jax.tree.map(
-                        lambda x: jnp.zeros((cap_f, *x.shape[1:]), x.dtype),
-                        ch_f,
-                    )
-                dst = _write_slice(dst, ch_f, jnp.asarray(si * sl, I32))
-            else:
-                child_parts.append(ch_f)
+            child_parts.append(ch_f)
             bad_ds.append(bad_d)
             ovf_ds.append(ovf_d)
-            if si % 4 == 3:
-                jax.device_get(bad_d)  # bound the dispatch queue
-        return (dst if built else child_parts, bad_ds, ovf_ds, n_slices, sl,
-                built)
+            # bound the dispatch queue; at deep-sweep slice widths every
+            # in-flight slice pins GB-scale working sets, so drain one at
+            # a time there
+            if sl >= 16384 or si % 4 == 3:
+                jax.device_get(bad_d)
+        return child_parts, bad_ds, ovf_ds, n_slices, sl
 
     def _frontier_cap(self, n: int) -> int:
         """Frontier capacity for n states: half-step quantized, but ONLY
@@ -744,7 +724,9 @@ class JaxChecker:
             j_los.append(j_lo)
         n_seg_d = _pick_segments(cap_f, sl)
         seg_d = cap_f // n_seg_d
+        per_seg = seg_d // sl
         dst = [None] * n_seg_d
+        parts_buf = []
         bad_ds, ovf_ds = [], []
         for si in range(n_slices):
             take = min(sl, n_new - si * sl)
@@ -754,19 +736,62 @@ class JaxChecker:
                 segs[j], segs[min(j + 1, n_par - 1)],
                 jnp.asarray(j * L, I64), pay_slice, jnp.asarray(take, I64),
             )
-            dj, off = divmod(si * sl, seg_d)
-            if dst[dj] is None:
+            parts_buf.append(part)
+            if len(parts_buf) == per_seg or si == n_slices - 1:
+                # seal one destination segment: a bounded concat (the
+                # transient is two segments, never two frontiers — no
+                # donation semantics assumed, see note at top)
+                dj = (si * sl) // seg_d
                 dst[dj] = jax.tree.map(
-                    lambda x: jnp.zeros((seg_d, *x.shape[1:]), x.dtype), part
+                    lambda *xs: _pad_axis0(jnp.concatenate(xs), seg_d),
+                    *parts_buf,
                 )
-            dst[dj] = _write_slice(dst[dj], part, jnp.asarray(off, I32))
+                parts_buf = []
             for k in range(j):  # the walk has passed these parents for good
                 segs[k] = None
             bad_ds.append(bad_d)
             ovf_ds.append(ovf_d)
-            if si % 4 == 3:
+            if sl >= 16384 or si % 4 == 3:
                 jax.device_get(bad_d)
         for dj in range(n_seg_d):  # untouched capacity tail
+            if dst[dj] is None:
+                dst[dj] = jax.tree.map(jnp.zeros_like, dst[0])
+        return dst, bad_ds, ovf_ds, n_slices, sl
+
+    def _materialize_fallback_segs(self, whole, new_payload, n_new):
+        """Whole-parent materialize that still emits a SEGMENTED
+        destination with bounded concat transients — the external-store
+        path for legacy (non-ascending) records and tiny levels."""
+        sl = min(4 * self.chunk, new_payload.shape[0])
+        n_slices = -(-n_new // sl)
+        cap_f = self._frontier_cap(n_new)
+        n_seg_d = _pick_segments(cap_f, sl) if n_slices * sl <= cap_f else 1
+        seg_d = cap_f // n_seg_d
+        # a single-segment destination seals once, at the end (tiny levels
+        # whose slice tiling overshoots the capacity get truncated there)
+        per_seg = seg_d // sl if n_seg_d > 1 else n_slices
+        dst = [None] * n_seg_d
+        parts_buf = []
+        bad_ds, ovf_ds = [], []
+        for si in range(n_slices):
+            take = min(sl, n_new - si * sl)
+            pay_slice = jax.lax.dynamic_slice_in_dim(new_payload, si * sl, sl)
+            ch_f, bad_d, ovf_d = self._mat_slice(
+                whole, pay_slice, jnp.asarray(take, I64)
+            )
+            parts_buf.append(ch_f)
+            if len(parts_buf) == per_seg or si == n_slices - 1:
+                dj = min((si * sl) // seg_d, n_seg_d - 1)
+                dst[dj] = jax.tree.map(
+                    lambda *xs: _pad_axis0(jnp.concatenate(xs), seg_d),
+                    *parts_buf,
+                )
+                parts_buf = []
+            bad_ds.append(bad_d)
+            ovf_ds.append(ovf_d)
+            if sl >= 16384 or si % 4 == 3:
+                jax.device_get(bad_d)
+        for dj in range(n_seg_d):
             if dst[dj] is None:
                 dst[dj] = jax.tree.map(jnp.zeros_like, dst[0])
         return dst, bad_ds, ovf_ds, n_slices, sl
@@ -804,12 +829,6 @@ class JaxChecker:
         segment list too.  Returns (new_frontier, bads, n_slices, sl,
         parent) — the new frontier is at its _frontier_cap capacity.
         """
-        def concat_pad(parts):
-            cap_f = self._frontier_cap(n_new)
-            return jax.tree.map(
-                lambda *xs: _pad_axis0(jnp.concatenate(xs), cap_f), *parts
-            )
-
         while True:
             segged = False
             retry_parent = None
@@ -831,21 +850,24 @@ class JaxChecker:
                             lambda *xs: jnp.concatenate(xs), *frontier
                         )
                     )
-                    out, bad_ds, ovf_ds, n_slices, sl, built = (
-                        self._materialize_payload_slices(
+                    out, bad_ds, ovf_ds, n_slices, sl = (
+                        self._materialize_fallback_segs(
                             whole, new_payload, n_new
                         )
                     )
-                    out = [out if built else concat_pad(out)]
                     retry_parent = whole
             else:
-                out, bad_ds, ovf_ds, n_slices, sl, built = (
+                parts, bad_ds, ovf_ds, n_slices, sl = (
                     self._materialize_payload_slices(
                         frontier, new_payload, n_new
                     )
                 )
-                if not built:
-                    out = concat_pad(out)
+                cap_f = self._frontier_cap(n_new)
+                out = jax.tree.map(
+                    lambda *xs: _pad_axis0(jnp.concatenate(xs), cap_f),
+                    *parts,
+                )
+                del parts
                 retry_parent = frontier
             bads, ovfs = jax.device_get((bad_ds, ovf_ds))
             if not any(bool(np.asarray(o)) for o in ovfs):
