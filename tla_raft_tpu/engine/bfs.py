@@ -700,7 +700,12 @@ class JaxChecker:
         window-less whole-parent path.
         """
         K = self.K
-        sl = min(4 * self.chunk, new_payload.shape[0])
+        # one-chunk slices: the materialize program's transient workspace
+        # (the scatter-free message-set inflate is ~60 KB/state on this
+        # family) scales with slice width — 4*chunk slices cost ~4 GB of
+        # HBM headroom for ~20 s/level of dispatch savings, a bad trade
+        # this close to the ceiling
+        sl = min(self.chunk, new_payload.shape[0])
         n_slices = -(-n_new // sl)
         cap_f = self._frontier_cap(n_new)
         if n_slices * sl > cap_f:
@@ -762,7 +767,7 @@ class JaxChecker:
         """Whole-parent materialize that still emits a SEGMENTED
         destination with bounded concat transients — the external-store
         path for legacy (non-ascending) records and tiny levels."""
-        sl = min(4 * self.chunk, new_payload.shape[0])
+        sl = min(self.chunk, new_payload.shape[0])  # see _materialize_segs
         n_slices = -(-n_new // sl)
         cap_f = self._frontier_cap(n_new)
         n_seg_d = _pick_segments(cap_f, sl) if n_slices * sl <= cap_f else 1
