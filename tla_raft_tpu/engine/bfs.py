@@ -117,16 +117,43 @@ def _pow2(n: int) -> int:
     return 1 << max(0, (n - 1)).bit_length()
 
 
-def _pick_segments(cap_f: int, sl: int, max_seg: int = 8) -> int:
-    """Segment count for a frontier of capacity cap_f (external-store
-    path): the largest power of two <= max_seg that divides cap_f into
-    slice-aligned segments.  Segments are the unit of progressive parent
-    freeing during materialization — the reason the deep sweep's peak is
-    ~dst + one segment instead of dst + whole parent."""
-    n = max_seg
-    while n > 1 and (cap_f % n or (cap_f // n) % sl):
-        n //= 2
-    return n
+# Uniform segment size for external-store frontiers (rows).  ONE fixed
+# buffer shape per field across every deep level serves two masters:
+# the BFC allocator recycles identical slabs instead of fragmenting HBM
+# over a replay's worth of odd-sized trees (measured: a fresh process
+# can allocate 15.7 GB in one piece, but the replay OOMed at ~12 GB of
+# live accounting), and the two-segment materialize gather compiles ONCE
+# instead of once per frontier magnitude (remote compiles are minutes).
+SEG_ROWS = 1 << 21
+
+
+def _concat_fields(segs: list) -> Frontier:
+    """Collapse a segment list into one frontier, FIELD BY FIELD, consuming
+    the list: the naive tree-level concat holds the whole parent twice
+    (inputs + outputs across all fields at once); sequencing per field and
+    dropping the source column as soon as its concat lands caps the spike
+    at ~one parent plus its largest field (the message-id lanes, ~60% of
+    state bytes) instead of two parents."""
+    if len(segs) == 1:
+        return segs[0]
+    cols = {f: [getattr(s, f) for s in segs] for f in Frontier._fields}
+    segs[:] = []  # drop the tuples so each column is the last reference
+    out = {}
+    for f in Frontier._fields:
+        out[f] = jnp.concatenate(cols[f])
+        cols[f] = None
+    return Frontier(**out)
+
+
+def _host_cap(n: int, chunk: int) -> int:
+    """Frontier capacity on the external-store path: whole uniform
+    segments once past one segment, else the small-level quantizer."""
+    if n > SEG_ROWS:
+        return -(-n // SEG_ROWS) * SEG_ROWS
+    c = _cap_steps(n)
+    if c % chunk:
+        c = _pow2(n)
+    return max(c, chunk)
 
 
 def _cap_steps(n: int) -> int:
@@ -707,7 +734,7 @@ class JaxChecker:
         # this close to the ceiling
         sl = min(self.chunk, new_payload.shape[0])
         n_slices = -(-n_new // sl)
-        cap_f = self._frontier_cap(n_new)
+        cap_f = _host_cap(n_new, self.chunk)
         if n_slices * sl > cap_f:
             return None
         # the window reasoning below is sound only for globally ascending
@@ -727,9 +754,9 @@ class JaxChecker:
             if p_hi >= min(j_lo + 2, n_par) * L:
                 return None  # parent span exceeds the 2-segment window
             j_los.append(j_lo)
-        n_seg_d = _pick_segments(cap_f, sl)
-        seg_d = cap_f // n_seg_d
-        per_seg = seg_d // sl
+        seg_d = SEG_ROWS if cap_f > SEG_ROWS else cap_f
+        n_seg_d = cap_f // seg_d
+        per_seg = seg_d // sl if n_seg_d > 1 else n_slices
         dst = [None] * n_seg_d
         parts_buf = []
         bad_ds, ovf_ds = [], []
@@ -769,9 +796,12 @@ class JaxChecker:
         path for legacy (non-ascending) records and tiny levels."""
         sl = min(self.chunk, new_payload.shape[0])  # see _materialize_segs
         n_slices = -(-n_new // sl)
-        cap_f = self._frontier_cap(n_new)
-        n_seg_d = _pick_segments(cap_f, sl) if n_slices * sl <= cap_f else 1
-        seg_d = cap_f // n_seg_d
+        cap_f = _host_cap(n_new, self.chunk)
+        if n_slices * sl > cap_f:
+            seg_d, n_seg_d = cap_f, 1
+        else:
+            seg_d = SEG_ROWS if cap_f > SEG_ROWS else cap_f
+            n_seg_d = cap_f // seg_d
         # a single-segment destination seals once, at the end (tiny levels
         # whose slice tiling overshoots the capacity get truncated there)
         per_seg = seg_d // sl if n_seg_d > 1 else n_slices
@@ -848,13 +878,7 @@ class JaxChecker:
                     out, bad_ds, ovf_ds, n_slices, sl = res
                     segged = True
                 else:
-                    whole = (
-                        frontier[0]
-                        if len(frontier) == 1
-                        else jax.tree.map(
-                            lambda *xs: jnp.concatenate(xs), *frontier
-                        )
-                    )
+                    whole = _concat_fields(frontier)
                     out, bad_ds, ovf_ds, n_slices, sl = (
                         self._materialize_fallback_segs(
                             whole, new_payload, n_new
