@@ -58,7 +58,7 @@ GOLDEN_LEVELS = {
     (3, 2, 3, 3): [
         1, 1, 3, 9, 22, 57, 136, 345, 931, 2468, 5881, 12505, 24705,
         47599, 91014, 169607, 301664, 511609, 839797, 1353766, 2150466,
-        3350017, 5099018, 7596394, 11125029,
+        3350017, 5099018, 7596394, 11125029, 16077143, 22959572,
     ],
 }
 

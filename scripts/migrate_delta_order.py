@@ -1,0 +1,89 @@
+"""One-off migration: rewrite a delta log into ascending-payload order.
+
+Why: the engine's records were historically written in canonical-
+fingerprint order; replaying them forces whole-frontier parent gathers,
+whose XLA:TPU lowering materializes operand-sized temporaries (~4.3 GB
+at a 16.8M-row frontier — measured via memory_analysis), which OOMs the
+deep-sweep replay.  Ascending-payload records replay through the
+segment-windowed gather instead (temp ~ 2 uniform segments).
+
+The migration is pure bookkeeping: level k's rows are sorted by payload
+(pidx*K + slot; unique, so deterministic), and level k+1's pidx values
+— which index into level k's ROW ORDER — are remapped through the sort
+permutation.  In-flight partial_*.npz files (whose hp payloads embed
+parent indices in the pre-migration order of the LAST delta level) are
+value-remapped the same way.  base.npz and the fps/mult content are
+untouched; only row order and index values change, so the replayed
+state SET is identical.
+
+Usage: python scripts/migrate_delta_order.py states_delta [K]
+Idempotent (sorted levels produce identity permutations).
+"""
+
+import glob
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    ckdir = sys.argv[1] if len(sys.argv) > 1 else "states_delta"
+    if len(sys.argv) > 2:
+        K = int(sys.argv[2])
+    else:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from tla_raft_tpu.cfgparse import load_raft_config
+        from tla_raft_tpu.ops.successor import get_kernel
+
+        K = get_kernel(load_raft_config("/root/reference/Raft.cfg")).K
+    files = sorted(glob.glob(os.path.join(ckdir, "delta_*.npz")))
+    if not files:
+        print(f"no delta files under {ckdir}")
+        return 0
+    # rank[i] = new row of old row i in the PREVIOUS level (identity for
+    # the first file's parent — the base frontier order is untouched)
+    rank = None
+    for f in files:
+        z = np.load(f)
+        pidx = z["pidx"].astype(np.int64)
+        slot = z["slot"].astype(np.int64)
+        if rank is not None:
+            pidx = rank[pidx]
+        pay = pidx * K + slot
+        order = np.argsort(pay)  # unique keys -> deterministic
+        inv = np.empty_like(order)
+        inv[order] = np.arange(len(order))
+        changed = not np.array_equal(order, np.arange(len(order)))
+        meta = z["meta"]
+        out = dict(
+            pidx=pidx[order].astype(z["pidx"].dtype),
+            slot=slot[order].astype(z["slot"].dtype),
+            fps=z["fps"][order],
+            mult=z["mult"],
+            meta=meta,
+        )
+        tmp = f + ".tmp.npz"
+        np.savez(tmp, **out)
+        os.replace(tmp, f)
+        print(f"{os.path.basename(f)}: {'rewritten' if changed else 'already sorted'}"
+              f" ({len(order)} rows)")
+        rank = inv
+    # partials of the in-flight level reference the LAST delta's row order
+    for f in sorted(glob.glob(os.path.join(ckdir, "partial_*.npz"))):
+        z = np.load(f)
+        hp = z["hp"].astype(np.int64)
+        hp2 = rank[hp // K] * K + hp % K
+        tmp = f + ".tmp.npz"
+        np.savez(tmp, hv=z["hv"], hf=z["hf"], hp=hp2, mult=z["mult"],
+                 meta=z["meta"])
+        os.replace(tmp, f)
+        print(f"{os.path.basename(f)}: payloads remapped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -963,7 +963,27 @@ class JaxChecker:
                 # their location differs.
                 self._seed_host_store(visited_base)
                 visited_base = None
-                frontier = [frontier]  # host-path frontiers are seg lists
+                # host-path frontiers are segment lists; split a monolith
+                # frontier into uniform segments when it tiles evenly so
+                # the replay's first materialize gathers through windows
+                # (a whole-frontier gather materializes operand-sized
+                # temps on this backend — the gather cliff, docs/PERF.md)
+                rows = frontier.voted_for.shape[0]
+                if rows % SEG_ROWS == 0 and rows > SEG_ROWS:
+                    cut = jax.jit(
+                        lambda t, s: jax.tree.map(
+                            lambda x: jax.lax.dynamic_slice_in_dim(
+                                x, s, SEG_ROWS
+                            ),
+                            t,
+                        )
+                    )
+                    frontier = [
+                        cut(frontier, jnp.asarray(i * SEG_ROWS, I32))
+                        for i in range(rows // SEG_ROWS)
+                    ]
+                else:
+                    frontier = [frontier]
             fps_parts = []
             trace_levels = ck["trace_levels"]
             level_sizes = list(ck["level_sizes"])
