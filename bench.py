@@ -59,6 +59,7 @@ GOLDEN_LEVELS = {
         1, 1, 3, 9, 22, 57, 136, 345, 931, 2468, 5881, 12505, 24705,
         47599, 91014, 169607, 301664, 511609, 839797, 1353766, 2150466,
         3350017, 5099018, 7596394, 11125029, 16077143, 22959572,
+        32391457, 45102507,
     ],
 }
 
