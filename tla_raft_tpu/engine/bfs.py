@@ -727,12 +727,15 @@ class JaxChecker:
         window-less whole-parent path.
         """
         K = self.K
-        # one-chunk slices: the materialize program's transient workspace
-        # (the scatter-free message-set inflate is ~60 KB/state on this
-        # family) scales with slice width — 4*chunk slices cost ~4 GB of
-        # HBM headroom for ~20 s/level of dispatch savings, a bad trade
-        # this close to the ceiling
-        sl = min(self.chunk, new_payload.shape[0])
+        # one-chunk slices at deep-sweep chunk sizes: the materialize
+        # program's transient workspace (the scatter-free message-set
+        # inflate is ~60 KB/state on this family) scales with slice width
+        # — 4*chunk slices cost ~4 GB of HBM headroom for ~20 s/level of
+        # dispatch savings, a bad trade close to the ceiling.  Tiny
+        # (test-scale) chunks keep the wider slices: their workspace is
+        # KBs and 4x the dispatch count quadruples CPU-suite wall time.
+        sl_quantum = self.chunk if self.chunk >= 2048 else 4 * self.chunk
+        sl = min(sl_quantum, new_payload.shape[0])
         n_slices = -(-n_new // sl)
         cap_f = _host_cap(n_new, self.chunk)
         if n_slices * sl > cap_f:
@@ -794,7 +797,8 @@ class JaxChecker:
         """Whole-parent materialize that still emits a SEGMENTED
         destination with bounded concat transients — the external-store
         path for legacy (non-ascending) records and tiny levels."""
-        sl = min(self.chunk, new_payload.shape[0])  # see _materialize_segs
+        sl_quantum = self.chunk if self.chunk >= 2048 else 4 * self.chunk
+        sl = min(sl_quantum, new_payload.shape[0])  # see _materialize_segs
         n_slices = -(-n_new // sl)
         cap_f = _host_cap(n_new, self.chunk)
         if n_slices * sl > cap_f:
