@@ -42,11 +42,17 @@ import os
 import sys
 import time
 
-# The full-space golden counts for /root/reference/Raft.cfg as-is, pinned
-# by the first completed sweep (see BASELINE.md "golden counts").  None
-# until a sweep has completed; filled in so every later bench is gated.
+# Full-space golden totals for completed (empty-frontier) fixpoint runs,
+# keyed (S, V, max_election, max_restart) -> (distinct, generated, depth).
+# Pinned from the independent native C++ checker (native/cpubase.cpp) and
+# cross-verified with the Python oracle; a BENCH_MAX_DEPTH=0 run of a
+# pinned config FAILS unless it lands exactly here.  The as-is reference
+# config's fixpoint (~10^9 states, BASELINE.md) has not been reached by
+# any engine yet and stays unpinned.
 GOLDEN_FULL = {
-    # (S, V, max_election, max_restart): (distinct, generated, depth)
+    (3, 1, 2, 1): (180_582, 747_500, 35),
+    (3, 1, 2, 2): (223_437, 936_729, 36),
+    (3, 2, 2, 0): (4_850_261, 26_087_894, 45),
 }
 
 # Per-level new-state counts of the deepest verified record (BASELINE.md
@@ -64,11 +70,103 @@ GOLDEN_LEVELS = {
 }
 
 
+# Backend-init bulletproofing (VERDICT r3 weak #1: round 3's TPU number
+# was lost to a transient axon-tunnel flake at capture time).  Init is
+# retried with exponential backoff, each attempt in a FRESH process
+# (os.execve) because jax caches a failed backend for the life of the
+# interpreter; on final failure the bench still prints one parseable
+# JSON line with ok:false and the failure class instead of a traceback.
+MAX_INIT_ATTEMPTS = 5
+
+
+def _emit_failure(failure_class: str, exc: BaseException) -> None:
+    import traceback
+
+    traceback.print_exc(file=sys.stderr)
+    print(json.dumps({
+        "metric": "raft_cfg_check_failed",
+        "value": 0.0,
+        "unit": "distinct_states_per_sec",
+        "vs_baseline": 0.0,
+        "ok": False,
+        "parity": False,
+        "failure_class": failure_class,
+        "error": f"{type(exc).__name__}: {exc}"[:500],
+    }))
+
+
+def _init_jax_or_reexec():
+    attempt = int(os.environ.get("BENCH_INIT_ATTEMPT", "0"))
+    # per-attempt watchdog: the tunneled backend has been observed to HANG
+    # in setup (no exception, ever) — an alarm turns the hang into a retry
+    import signal
+
+    def _on_alarm(_sig, _frm):
+        raise TimeoutError(
+            f"backend init hung > {INIT_TIMEOUT_S}s (tunnel unresponsive)"
+        )
+
+    INIT_TIMEOUT_S = int(os.environ.get("BENCH_INIT_TIMEOUT_S", "300"))
+    old_handler = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(INIT_TIMEOUT_S)
+    try:
+        from tla_raft_tpu.platform import setup_jax
+
+        jax = setup_jax()
+        import numpy as _np
+        import jax.numpy as _jnp
+
+        # force one real device round-trip NOW so backend flakes surface
+        # inside the retry loop, not mid-run (block_until_ready does not
+        # block on the tunneled backend; a host fetch does)
+        got = int(_np.asarray(jax.device_get(_jnp.arange(4).sum())))
+        assert got == 6, f"device smoke op returned {got}"
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old_handler)
+        return jax
+    except Exception as e:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old_handler)
+        if attempt + 1 >= MAX_INIT_ATTEMPTS:
+            _emit_failure("backend_init", e)
+            sys.exit(1)
+        delay = 5.0 * (2 ** attempt)
+        print(
+            f"[bench] backend init failed "
+            f"(attempt {attempt + 1}/{MAX_INIT_ATTEMPTS}): "
+            f"{type(e).__name__}: {e}; re-exec in {delay:.0f}s",
+            file=sys.stderr,
+        )
+        sys.stderr.flush()
+        time.sleep(delay)
+        env = dict(os.environ, BENCH_INIT_ATTEMPT=str(attempt + 1))
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _best_window_rate(levels, fallback, max_level=None):
+    """Best trailing-window rate over >=25% of the states and >=2 levels.
+
+    Excludes the cold-compile ramp.  ``max_level`` restricts the search to
+    a depth prefix so the rate covers the same level mix as a depth-capped
+    baseline run (ADVICE r3: steady-vs-overall across different depths is
+    not comparable)."""
+    lv = [x for x in levels if max_level is None or x[0] <= max_level]
+    best = fallback
+    if not lv:
+        return best
+    total = lv[-1][1]
+    for i in range(len(lv)):
+        for j in range(i + 2, len(lv)):
+            dn = lv[j][1] - lv[i][1]
+            dtm = lv[j][2] - lv[i][2]
+            if dn >= total // 4 and dtm > 0:
+                best = max(best, dn / dtm)
+    return best
+
+
 def main():
     os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
-    from tla_raft_tpu.platform import setup_jax
-
-    jax = setup_jax()
+    jax = _init_jax_or_reexec()
 
     from tla_raft_tpu.cfgparse import load_raft_config
     from tla_raft_tpu.engine import JaxChecker
@@ -82,6 +180,8 @@ def main():
         overrides["n_vals"] = int(os.environ["BENCH_VALS"])
     if os.environ.get("BENCH_MAX_ELECTION"):
         overrides["max_election"] = int(os.environ["BENCH_MAX_ELECTION"])
+    if os.environ.get("BENCH_MAX_RESTART"):
+        overrides["max_restart"] = int(os.environ["BENCH_MAX_RESTART"])
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     # Default: a depth-19 prefix (~3.4M distinct states — deep enough that
@@ -135,10 +235,13 @@ def main():
     native = None
     try:
         nb = build_cpubase()
-        nproc = os.cpu_count() or 1
+        # 4 threads = the reference's own parallelism (`-workers 4`,
+        # /root/reference/myrun.sh:3), whatever this host's core count;
+        # host_cores is recorded so the ratio can be read honestly
+        nthreads = int(os.environ.get("BENCH_NATIVE_THREADS", "4"))
         out_n = _sp.run(
             [nb, str(cfg.S), str(cfg.V), str(cfg.max_election),
-             str(cfg.max_restart), str(native_depth), str(nproc)],
+             str(cfg.max_restart), str(native_depth), str(nthreads)],
             capture_output=True, text=True, timeout=3600, check=True,
         )
         native = _json.loads(out_n.stdout)
@@ -158,22 +261,31 @@ def main():
         )
         sys.stderr.flush()
 
-    res = JaxChecker(cfg, chunk=chunk, progress=progress).run(max_depth=max_depth)
+    try:
+        res = JaxChecker(cfg, chunk=chunk, progress=progress).run(
+            max_depth=max_depth
+        )
+    except Exception as e:
+        _emit_failure("engine_run", e)
+        return 1
     dt = time.monotonic() - t0
     overall_rate = res.distinct / dt
 
-    # steady-state rate: best window rate over >=25% of the states and
-    # >=2 levels (excludes the cold-compile ramp, which dominates early
-    # wall-clock; the frontier grows ~1.6x/level, so the last 2-3 levels
-    # hold most of the distinct states and a qualifying window typically
-    # covers >60% of the whole run)
-    steady = overall_rate
-    for i in range(len(levels)):
-        for j in range(i + 2, len(levels)):
-            dn = levels[j][1] - levels[i][1]
-            dtm = levels[j][2] - levels[i][2]
-            if dn >= res.distinct // 4 and dtm > 0:
-                steady = max(steady, dn / dtm)
+    # steady-state rate: best window excluding the cold-compile ramp
+    # (the frontier grows ~1.6x/level, so the last 2-3 levels hold most
+    # of the distinct states and a qualifying window covers >60% of the
+    # run).  vs_baseline uses the rate restricted to the SAME depth
+    # prefix the native baseline ran (ADVICE r3 low #4).
+    steady = _best_window_rate(levels, overall_rate)
+    # fallback for the prefix rate stays prefix-restricted (cumulative
+    # states/time at the prefix end), so vs_baseline never mixes depths
+    pre = [x for x in levels if x[0] <= native_depth]
+    prefix_fallback = (
+        pre[-1][1] / pre[-1][2] if pre and pre[-1][2] > 0 else overall_rate
+    )
+    steady_prefix = _best_window_rate(
+        levels, prefix_fallback, max_level=native_depth
+    )
 
     # ---- parity gates ---------------------------------------------------
     prefix = gold.level_sizes
@@ -198,8 +310,10 @@ def main():
         "value": round(steady, 1),
         "unit": "distinct_states_per_sec",
         "vs_baseline": round(
-            steady / (native["rate"] if native else oracle_rate), 2
+            (steady_prefix / native["rate"]) if native
+            else (steady / oracle_rate), 2
         ),
+        "steady_rate_same_prefix": round(steady_prefix, 1),
         "parity": parity,
         "distinct": res.distinct,
         "generated": res.generated,
@@ -215,6 +329,7 @@ def main():
                 "depth_cap": native_depth,
                 "wall_s": native["seconds"],
                 "threads": native["threads"],
+                "host_cores": os.cpu_count(),
             }
             if native
             else {"impl": "python_oracle", "rate": round(oracle_rate, 1)}

@@ -10,11 +10,18 @@ segment-windowed gather instead (temp ~ 2 uniform segments).
 The migration is pure bookkeeping: level k's rows are sorted by payload
 (pidx*K + slot; unique, so deterministic), and level k+1's pidx values
 — which index into level k's ROW ORDER — are remapped through the sort
-permutation.  In-flight partial_*.npz files (whose hp payloads embed
-parent indices in the pre-migration order of the LAST delta level) are
-value-remapped the same way.  base.npz and the fps/mult content are
-untouched; only row order and index values change, so the replayed
-state SET is identical.
+permutation.  base.npz and the fps/mult content are untouched; only row
+order and index values change, so the replayed state SET is identical.
+
+In-flight partial_*.npz files are DELETED whenever any level was
+rewritten: a partial is keyed by group index, and group gi covers parent
+ROWS [gi*G*chunk, (gi+1)*G*chunk) of the frontier — permuting the parent
+level's row order changes group membership, so a value-remap of the hp
+payloads would leave the saved groups covering the OLD row ranges while
+fresh expansion uses the NEW ones, silently dropping the successors of
+any parent that moved across a saved-group boundary (advisor finding,
+round 3).  Deleting costs re-expanding one level's saved groups on
+resume; correctness is not negotiable.
 
 Usage: python scripts/migrate_delta_order.py states_delta [K]
 Idempotent (sorted levels produce identity permutations).
@@ -47,6 +54,7 @@ def main():
     # rank[i] = new row of old row i in the PREVIOUS level (identity for
     # the first file's parent — the base frontier order is untouched)
     rank = None
+    any_changed = False
     for f in files:
         z = np.load(f)
         pidx = z["pidx"].astype(np.int64)
@@ -58,6 +66,7 @@ def main():
         inv = np.empty_like(order)
         inv[order] = np.arange(len(order))
         changed = not np.array_equal(order, np.arange(len(order)))
+        any_changed = any_changed or changed
         meta = z["meta"]
         out = dict(
             pidx=pidx[order].astype(z["pidx"].dtype),
@@ -72,16 +81,17 @@ def main():
         print(f"{os.path.basename(f)}: {'rewritten' if changed else 'already sorted'}"
               f" ({len(order)} rows)")
         rank = inv
-    # partials of the in-flight level reference the LAST delta's row order
-    for f in sorted(glob.glob(os.path.join(ckdir, "partial_*.npz"))):
-        z = np.load(f)
-        hp = z["hp"].astype(np.int64)
-        hp2 = rank[hp // K] * K + hp % K
-        tmp = f + ".tmp.npz"
-        np.savez(tmp, hv=z["hv"], hf=z["hf"], hp=hp2, mult=z["mult"],
-                 meta=z["meta"])
-        os.replace(tmp, f)
-        print(f"{os.path.basename(f)}: payloads remapped")
+    # partials of the in-flight level are keyed by parent ROW RANGES
+    # (group gi = rows [gi*G*chunk, (gi+1)*G*chunk)); a row-order rewrite
+    # invalidates that keying, so they must go — see module docstring
+    partials = sorted(glob.glob(os.path.join(ckdir, "partial_*.npz")))
+    for f in partials:
+        if any_changed:
+            os.unlink(f)
+            print(f"{os.path.basename(f)}: deleted (parent row order "
+                  "changed; group membership is row-range-keyed)")
+        else:
+            print(f"{os.path.basename(f)}: kept (no level rewritten)")
     return 0
 
 

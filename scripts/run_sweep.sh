@@ -21,6 +21,11 @@ export XLA_PYTHON_CLIENT_MEM_FRACTION="${XLA_PYTHON_CLIENT_MEM_FRACTION:-0.94}"
 # level ever overflows, the segmented path raises with instructions and
 # the delta log resumes under a bumped TLA_RAFT_CAP_M.
 export TLA_RAFT_CAP_M="${TLA_RAFT_CAP_M:-96}"
+# host-RAM segment paging: past level 28 one level's parent+child
+# frontiers exceed HBM (BASELINE.md's level-29 wall); under this budget
+# sealed child segments demote to host RAM and page back on demand.
+# ~11 GB leaves headroom for the expand/dedup programs' transients.
+export TLA_RAFT_DEV_BYTES="${TLA_RAFT_DEV_BYTES:-11000000000}"
 CKDIR=states_delta
 TRIES=0
 MAX_TRIES=40

@@ -15,6 +15,8 @@ from tla_raft_tpu.engine import JaxChecker
 from tla_raft_tpu.oracle import OracleChecker
 from tla_raft_tpu.oracle.explicit import canonical_key, init_state, successors
 
+pytestmark = pytest.mark.slow  # 16 full BFS differentials, ~10 min on 1 CPU
+
 PARITY_CFGS = [
     RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1, symmetry=False),
     RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1, symmetry=True),

@@ -1,0 +1,81 @@
+"""Host-RAM segment paging: the tier that breaks the HBM frontier wall.
+
+The deep sweep of /root/reference/Raft.cfg walls at level 29 on a single
+16 GB chip — one level's child frontier alone (~15 GB) no longer fits
+(BASELINE.md).  Under a device-byte budget (TLA_RAFT_DEV_BYTES /
+``JaxChecker.dev_budget``), sealed child segments demote to host RAM
+and page back in on demand; both the expand and the materialize walks
+consume segments in ascending payload order, so device residency is a
+moving window.  This is TLC's disk-spill move
+(/root/reference/.gitignore:2) applied between HBM and host RAM.
+
+These tests shrink SEG_ROWS so multi-segment frontiers (and therefore
+paging) happen at test scale, and force the tightest budget (every seal
+demotes) — the checker must still reproduce the oracle exactly.
+"""
+
+import numpy as np
+import pytest
+
+import tla_raft_tpu.engine.bfs as bfs
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.native import HostFPStore
+from tla_raft_tpu.oracle import OracleChecker
+
+pytestmark = pytest.mark.slow
+
+CFG = RaftConfig(n_servers=3, n_vals=1, max_election=2, max_restart=0)
+
+
+def test_paged_sweep_matches_oracle(tmp_path, monkeypatch):
+    monkeypatch.setattr(bfs, "SEG_ROWS", 256)
+    want = OracleChecker(CFG).run(max_depth=14)
+    chk = JaxChecker(
+        CFG, chunk=64, host_store=HostFPStore(str(tmp_path / "fps"))
+    )
+    chk.dev_budget = 1  # tightest budget: every sealed segment demotes
+    got = chk.run(max_depth=14)
+    assert chk.paged_out > 0, "paging never engaged — test is vacuous"
+    assert got.ok == want.ok
+    assert got.distinct == want.distinct
+    assert got.generated == want.generated
+    assert got.level_sizes == want.level_sizes
+
+
+def test_paged_sweep_kill_resume(tmp_path, monkeypatch):
+    """Delta-log resume must replay correctly through paged frontiers
+    (the replay's materialize demotes under the same budget)."""
+    monkeypatch.setattr(bfs, "SEG_ROWS", 256)
+    want = OracleChecker(CFG).run(max_depth=12)
+    ck = str(tmp_path / "ck")
+
+    # depth 10: the level-10 frontier (414 states) is the first to span
+    # multiple 256-row segments, so the paged materialize path has run
+    chk1 = JaxChecker(
+        CFG, chunk=64, host_store=HostFPStore(str(tmp_path / "fps1"))
+    )
+    chk1.dev_budget = 1
+    half = chk1.run(max_depth=10, checkpoint_dir=ck)
+    assert half.depth == 10 and chk1.paged_out > 0
+
+    chk2 = JaxChecker(
+        CFG, chunk=64, host_store=HostFPStore(str(tmp_path / "fps2"))
+    )
+    chk2.dev_budget = 1
+    res = chk2.run(resume_from=ck, checkpoint_dir=ck, max_depth=12)
+    assert res.ok == want.ok
+    assert res.distinct == want.distinct
+    assert res.generated == want.generated
+    assert res.level_sizes == want.level_sizes
+
+
+def test_unbudgeted_run_never_pages(tmp_path, monkeypatch):
+    monkeypatch.setattr(bfs, "SEG_ROWS", 256)
+    chk = JaxChecker(
+        CFG, chunk=64, host_store=HostFPStore(str(tmp_path / "fps"))
+    )
+    assert chk.dev_budget == 0
+    got = chk.run(max_depth=10)
+    assert chk.paged_out == 0
+    assert got.level_sizes == OracleChecker(CFG).run(max_depth=10).level_sizes

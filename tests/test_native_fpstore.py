@@ -5,6 +5,10 @@ import pytest
 
 from tla_raft_tpu.native import HostFPStore, build_native
 
+# the engine-differential members run depth-12 sweeps at chunk=32 (the
+# deep sweep's many-group shape at test scale) — minutes-class on one CPU
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def built():

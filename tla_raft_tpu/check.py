@@ -120,9 +120,12 @@ def main(argv=None) -> int:
     p.add_argument("--no-symmetry", action="store_true")
     p.add_argument("--no-view", action="store_true")
     p.add_argument("--mutate", action="append", default=None,
-                   choices=("median-bug", "double-vote"),
-                   help="compile in a planted spec bug (SURVEY §4.4; the "
-                        "checker must then find an Inv violation)")
+                   choices=("median-bug", "double-vote", "legacy-append",
+                            "become-follower"),
+                   help="compile in a planted spec bug or a dead legacy "
+                        "action variant (SURVEY §4.4; the checker must "
+                        "then find a violation or a state-count "
+                        "divergence from the live spec)")
     p.add_argument("--servers", type=int, default=None, help="override |Servers|")
     p.add_argument("--vals", type=int, default=None, help="override |Vals|")
     p.add_argument("--max-election", type=int, default=None)
@@ -228,10 +231,7 @@ def main(argv=None) -> int:
             out.flush()
 
         host_store = None
-        if args.fpstore_dir:
-            if args.mesh:
-                p.error("--fpstore-dir is not supported with --mesh yet "
-                        "(the distributed store is device-sharded)")
+        if args.fpstore_dir and not args.mesh:
             from .native import HostFPStore
 
             host_store = HostFPStore(args.fpstore_dir)
@@ -242,11 +242,17 @@ def main(argv=None) -> int:
             print(f"Native FP store: {args.fpstore_dir}", file=out)
 
         if args.mesh:
+            if args.fpstore_dir:
+                # mesh x external store: one HostFPStore per owner shard
+                # (fp % D), host-filtered after the all_to_all routing
+                print(f"Native FP store (owner-sharded x{args.mesh}): "
+                      f"{args.fpstore_dir}", file=out)
             from .parallel import ShardedChecker, make_mesh
 
             res = ShardedChecker(
                 cfg, make_mesh(args.mesh), cap_x=args.cap_x,
                 exchange=args.exchange, progress=progress, canon=args.canon,
+                host_store_dir=args.fpstore_dir or None,
             ).run(
                 max_depth=args.max_depth,
                 checkpoint_dir=args.checkpoint_dir,

@@ -63,6 +63,17 @@ class RaftConfig:
         (``pos == Len(mlist) \\div 2`` on the descending-sorted list,
         Raft.tla:65-66): commits at one order statistic above the
         majority median, an over-commit the checker must catch.
+        "double-vote" — drops ResponseVote's votedFor guard, making the
+        in-path split-brain Assert (Raft.tla:185) reachable.
+        "legacy-append" — compiles the dead monolithic
+        ``FollowerAppendEntry`` (Raft.tla:323-371) in place of the live
+        accept/reject pair: rejects carry ``prevLogIndex - 1`` (:364 vs
+        :314) and accepts gain the :347-348 send-guard — detected by
+        state-count divergence from the live spec.
+        "become-follower" — compiles the dead ``BecomeFollower`` family
+        (Raft.tla:191-231) in place of ``UpdateTerm``: a Follower keeps
+        its votedFor on term adoption and the split-brain Assert is gone
+        — detected by state-count divergence.
     """
 
     n_servers: int = 3

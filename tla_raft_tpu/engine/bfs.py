@@ -113,6 +113,32 @@ class Frontier(NamedTuple):
 _CORE_FIELDS = [f for f in RaftState._fields if f != "msgs"]
 
 
+class _HostSeg:
+    """A frontier segment demoted to host RAM (numpy field dict).
+
+    The single-chip deep sweep walls when one level's frontier outgrows
+    HBM (level 29 of the reference config: ~15 GB of children at a
+    16 GB chip — BASELINE.md).  TLC's answer is disk spill
+    (/root/reference/.gitignore:2); ours is this tier: sealed
+    destination segments demote to host RAM under a device-byte budget
+    (TLA_RAFT_DEV_BYTES) and page back in on demand — the expand and
+    materialize walks both consume segments in ascending payload order,
+    so residency is a moving window, not a working set."""
+
+    __slots__ = ("fields",)
+
+    def __init__(self, fields: dict):
+        self.fields = fields
+
+    @property
+    def rows(self) -> int:
+        return self.fields["voted_for"].shape[0]
+
+
+def _seg_rows(seg) -> int:
+    return seg.rows if isinstance(seg, _HostSeg) else seg.voted_for.shape[0]
+
+
 def _pow2(n: int) -> int:
     return 1 << max(0, (n - 1)).bit_length()
 
@@ -434,13 +460,39 @@ class JaxChecker:
         # when set, the device keeps no visited table at all — the level's
         # deduped candidates are filtered through the host store instead
         self.host_store = host_store
+        # device-byte budget for frontier segments (0 = paging off): when
+        # one level's parent+child segments would exceed it, sealed child
+        # segments demote to host RAM and page back in on demand — the
+        # tier that breaks the single-frontier-in-HBM wall at level 29 of
+        # the reference sweep (BASELINE.md)
+        self.dev_budget = int(float(os.environ.get("TLA_RAFT_DEV_BYTES", "0")))
+        self.paged_out = 0  # sealed child segments demoted to host RAM
+        if host_store is not None and chunk > SEG_ROWS:
+            # the segment walkers assume chunks never straddle segment
+            # boundaries (chunk is pow2 and <= SEG_ROWS => SEG_ROWS % chunk
+            # == 0); a larger chunk would make divmod-based slices read
+            # past segment bounds (clamped dynamic_slice re-reads wrong
+            # parent rows silently)
+            raise ValueError(
+                f"chunk ({chunk}) must be <= SEG_ROWS ({SEG_ROWS}) "
+                "when an external host store is attached"
+            )
         self.inv_fns = [
             (n, resolve_invariant_kernel(n)) for n in cfg.invariants
         ]
         self._mat_slice = jax.jit(self._mat_slice_impl)
         self._mat_slice_seg = jax.jit(self._mat_slice_seg_impl)
         self._expand_chunk = jax.jit(self._expand_chunk_impl)
+        self._expand_span = jax.jit(self._expand_span_impl)
         self._inv_scan = jax.jit(self._inv_scan_impl)
+        # G-chunk span programs replace per-chunk dispatch at real chunk
+        # sizes: each per-chunk round costs ~13 host->device dispatches
+        # (12 eager field slices + the program) on the tunneled backend,
+        # which is most of the warm steady-state cost (docs/PERF.md "chunk
+        # cost = 38 ms fixed").  Tests drive tiny chunks through the
+        # per-chunk path (some monkeypatch _expand_chunk); lower this to
+        # exercise spans at test scale.
+        self.span_min_chunk = 2048
 
     # -- sparse <-> dense message-set conversion ---------------------------
 
@@ -579,6 +631,45 @@ class JaxChecker:
             fpf = jnp.where(valid, exp.fp_full, SENT).ravel()
             cv, cf, cp, overflow = _chunk_compact(fpv, fpf, payload, self.cap_x)
         return cv, cf, cp, mult_slots, abort_at, overflow
+
+    def _expand_span_impl(self, frontier, slice_base, global_base, n_f):
+        """G chunks in ONE program via lax.scan.
+
+        The per-chunk host loop costs ~13 dispatches per chunk (12 eager
+        per-field slices + the expand program); on the tunneled backend
+        that dispatch latency — not compute — dominates warm levels
+        (docs/PERF.md).  Scanning G chunks inside one jitted program cuts
+        the level's dispatch count by ~G*13.
+
+        ``frontier`` is the whole frontier (or one uniform segment on the
+        external-store path); ``slice_base`` is the row offset of the
+        span's first chunk within it, ``global_base`` the same position
+        in global frontier coordinates (they differ on segment operands —
+        payloads and in-range masks are global).  Returns stacked
+        [G, cap_x] candidate arrays + span-reduced stats.
+        """
+
+        def body(carry, i):
+            mult_acc, ab_min, ovf_any = carry
+            part_f = jax.tree.map(
+                lambda x: jax.lax.dynamic_slice_in_dim(
+                    x, slice_base + i * self.chunk, self.chunk
+                ),
+                frontier,
+            )
+            cv, cf, cp, mult, ab, ovf = self._expand_chunk_impl(
+                part_f, global_base + i * self.chunk, n_f
+            )
+            return (
+                (mult_acc + mult, jnp.minimum(ab_min, ab), ovf_any | ovf),
+                (cv, cf, cp),
+            )
+
+        init = (jnp.zeros((self.K,), I64), BIG, jnp.zeros((), bool))
+        (mult, ab, ovf), (cvs, cfs, cps) = jax.lax.scan(
+            body, init, jnp.arange(self.G, dtype=I64)
+        )
+        return cvs, cfs, cps, mult, ab, ovf
 
     def _inv_scan_impl(self, children: RaftState, n_valid):
         """All configured invariants over a level; (first_bad_idx|-1)."""
@@ -746,7 +837,7 @@ class JaxChecker:
         # gather clips onto WRONG PARENT ROWS with no error
         if not bool(np.all(np.diff(pay_np[:n_new].astype(np.int64)) > 0)):
             return None
-        L = segs[0].voted_for.shape[0]
+        L = _seg_rows(segs[0])
         n_par = len(segs)
         j_los = []
         for si in range(n_slices):
@@ -763,12 +854,27 @@ class JaxChecker:
         dst = [None] * n_seg_d
         parts_buf = []
         bad_ds, ovf_ds = [], []
+        # host-paged parents transit through this cache (segs keeps the
+        # numpy copy as the source of truth); seg_b prices one segment
+        # for the demotion decision at seal time
+        paged: dict[int, Frontier] = {}
+        seg_b = None
+
+        def par(j):
+            s = segs[j]
+            if isinstance(s, _HostSeg):
+                d = paged.get(j)
+                if d is None:
+                    d = paged[j] = self._seg_to_dev(s)
+                return d
+            return s
+
         for si in range(n_slices):
             take = min(sl, n_new - si * sl)
             j = j_los[si]
             pay_slice = jax.lax.dynamic_slice_in_dim(new_payload, si * sl, sl)
             part, bad_d, ovf_d = self._mat_slice_seg(
-                segs[j], segs[min(j + 1, n_par - 1)],
+                par(j), par(min(j + 1, n_par - 1)),
                 jnp.asarray(j * L, I64), pay_slice, jnp.asarray(take, I64),
             )
             parts_buf.append(part)
@@ -777,20 +883,47 @@ class JaxChecker:
                 # transient is two segments, never two frontiers — no
                 # donation semantics assumed, see note at top)
                 dj = (si * sl) // seg_d
-                dst[dj] = jax.tree.map(
+                sealed = jax.tree.map(
                     lambda *xs: _pad_axis0(jnp.concatenate(xs), seg_d),
                     *parts_buf,
                 )
                 parts_buf = []
+                if self.dev_budget:
+                    if seg_b is None:
+                        seg_b = self._tree_bytes(sealed)
+                    live = (
+                        sum(
+                            1 for k, s in enumerate(segs)
+                            if s is not None
+                            and (not isinstance(s, _HostSeg) or k in paged)
+                        )
+                        + sum(
+                            1 for d in dst
+                            if d is not None and not isinstance(d, _HostSeg)
+                        )
+                        + 2  # the transient concat + one in-flight slice
+                    )
+                    if (live + 1) * seg_b > self.dev_budget:
+                        sealed = self._seg_to_host(sealed)
+                        self.paged_out += 1
+                dst[dj] = sealed
             for k in range(j):  # the walk has passed these parents for good
                 segs[k] = None
+                paged.pop(k, None)
             bad_ds.append(bad_d)
             ovf_ds.append(ovf_d)
             if sl >= 16384 or si % 4 == 3:
                 jax.device_get(bad_d)
         for dj in range(n_seg_d):  # untouched capacity tail
             if dst[dj] is None:
-                dst[dj] = jax.tree.map(jnp.zeros_like, dst[0])
+                proto = next(d for d in dst if d is not None)
+                if isinstance(proto, _HostSeg):
+                    dst[dj] = _HostSeg(
+                        {f: np.zeros(v.shape, v.dtype)
+                         for f, v in proto.fields.items()}
+                    )
+                else:
+                    dst[dj] = jax.tree.map(jnp.zeros_like, proto)
         return dst, bad_ds, ovf_ds, n_slices, sl
 
     def _materialize_fallback_segs(self, whole, new_payload, n_new):
@@ -837,6 +970,16 @@ class JaxChecker:
 
     def _widen_msg_ids(self, frontier: Frontier) -> Frontier:
         """Pad the frontier's sparse message-id lanes out to self.cap_m."""
+        if isinstance(frontier, _HostSeg):
+            ids = frontier.fields["msg_ids"]
+            pad = self.cap_m - ids.shape[1]
+            if pad <= 0:
+                return frontier
+            f2 = dict(frontier.fields)
+            f2["msg_ids"] = np.concatenate(
+                [ids, np.full((ids.shape[0], pad), -1, ids.dtype)], axis=1
+            )
+            return _HostSeg(f2)
         ids = frontier.msg_ids
         pad = self.cap_m - ids.shape[1]
         if pad <= 0:
@@ -846,6 +989,27 @@ class JaxChecker:
                 [ids, jnp.full((ids.shape[0], pad), -1, ids.dtype)], axis=1
             )
         )
+
+    # -- host-RAM segment paging (the level-29 HBM wall breaker) -----------
+
+    def _seg_to_host(self, seg: Frontier) -> _HostSeg:
+        return _HostSeg(
+            {f: np.asarray(jax.device_get(getattr(seg, f)))
+             for f in Frontier._fields}
+        )
+
+    def _seg_to_dev(self, seg) -> Frontier:
+        if not isinstance(seg, _HostSeg):
+            return seg
+        return Frontier(**{f: jnp.asarray(v) for f, v in seg.fields.items()})
+
+    @staticmethod
+    def _tree_bytes(seg) -> int:
+        vals = (
+            seg.fields.values() if isinstance(seg, _HostSeg)
+            else (getattr(seg, f) for f in Frontier._fields)
+        )
+        return sum(int(np.prod(x.shape)) * x.dtype.itemsize for x in vals)
 
     def _materialize_grow(self, frontier, new_payload, n_new, pay_np=None):
         """Materialize survivors, auto-growing cap_m on overflow.
@@ -882,6 +1046,11 @@ class JaxChecker:
                     out, bad_ds, ovf_ds, n_slices, sl = res
                     segged = True
                 else:
+                    # the window-less path concats on device; page any
+                    # host-resident segments back in first (in place, so
+                    # _concat_fields' list-emptying still frees them)
+                    for i, s in enumerate(frontier):
+                        frontier[i] = self._seg_to_dev(s)
                     whole = _concat_fields(frontier)
                     out, bad_ds, ovf_ds, n_slices, sl = (
                         self._materialize_fallback_segs(
@@ -927,7 +1096,15 @@ class JaxChecker:
             print(f"[engine] cap_m overflow: growing to {self.cap_m} and "
                   f"re-materializing the level", file=sys.stderr)
             if isinstance(frontier, list):
-                frontier = [self._widen_msg_ids(s) for s in frontier]
+                if retry_parent is not None:
+                    # the fallback concat consumed the segment list in
+                    # place (_concat_fields empties it); retry on the
+                    # concatenated whole as a single segment, and drop
+                    # pay_np so the retry takes the fallback path again
+                    frontier = [self._widen_msg_ids(retry_parent)]
+                    pay_np = None
+                else:
+                    frontier = [self._widen_msg_ids(s) for s in frontier]
             else:
                 frontier = self._widen_msg_ids(retry_parent)
 
@@ -1164,6 +1341,7 @@ class JaxChecker:
         n_f_dev = jnp.asarray(n_f, I64)
         cvs, cfs, cps = [], [], []  # pending (unfiltered) chunk outputs
         gvs, gfs, gps = [], [], []  # filtered+compacted group outputs
+        svs, sfs, sps = [], [], []  # ungrouped span outputs ([G*cap_x] each)
         mult_acc = jnp.zeros((self.K,), I64)
         abort_at = BIG
         overflow = jnp.zeros((), bool)
@@ -1197,7 +1375,43 @@ class JaxChecker:
             cps.clear()
             return ovf
 
-        for start in range(0, max(n_f, 1), self.chunk):
+        # full G-chunk groups go through the scanned span program (one
+        # dispatch per G chunks instead of ~13 per chunk); the tail — and
+        # every test-scale chunk size — keeps the per-chunk path.  On
+        # grouped (deep) levels the span output feeds the group filter
+        # directly; on mid-size levels it joins the level-wide concat as
+        # G per-chunk-shaped entries.
+        start0 = 0
+        if self.chunk >= self.span_min_chunk and n_chunks >= G:
+            span_rows = G * self.chunk
+            for g in range(n_chunks // G):
+                b = jnp.asarray(g * span_rows, I64)
+                cvs_s, cfs_s, cps_s, mult_s, ab_s, ovf_s = self._expand_span(
+                    frontier, b, b, n_f_dev
+                )
+                mult_acc = mult_acc + mult_s
+                abort_at = jnp.minimum(abort_at, ab_s)
+                overflow = overflow | ovf_s
+                if grouping:
+                    gv, gf, gp, ovf_g = _group_filter(
+                        cvs_s.reshape(-1), cfs_s.reshape(-1),
+                        cps_s.reshape(-1), visited, self.cap_g,
+                    )
+                    overflow_g = overflow_g | ovf_g
+                    gvs.append(gv)
+                    gfs.append(gf)
+                    gps.append(gp)
+                else:
+                    svs.append(cvs_s.reshape(-1))
+                    sfs.append(cfs_s.reshape(-1))
+                    sps.append(cps_s.reshape(-1))
+                synced += 1
+                if synced >= self.sync_every:
+                    jax.device_get(abort_at)
+                    synced = 0
+            start0 = (n_chunks // G) * span_rows
+
+        for start in range(start0, max(n_f, 1), self.chunk):
             part_f = jax.tree.map(
                 lambda x: jax.lax.dynamic_slice_in_dim(x, start, self.chunk),
                 frontier,
@@ -1230,12 +1444,18 @@ class JaxChecker:
         if grouping and cvs:
             overflow_g = overflow_g | flush_group()
         if grouping:
-            lvs, lfs, lps, width = gvs, gfs, gps, self.cap_g
+            lvs, lfs, lps = gvs, gfs, gps
+            n_lanes = len(gvs) * self.cap_g
         else:
-            lvs, lfs, lps, width = cvs, cfs, cps, self.cap_x
+            # span outputs are [G*cap_x]-wide entries, chunk outputs
+            # [cap_x]; lane order is irrelevant to the level dedup
+            # (payloads are unique per lane, the sort is global)
+            lvs = svs + cvs
+            lfs = sfs + cfs
+            lps = sps + cps
+            n_lanes = (len(svs) * G + len(cvs)) * self.cap_x
         # pad the level-dedup input to a power-of-two lane count so its
         # sort program compiles O(log) times per run, not once per level
-        n_lanes = len(lvs) * width
         pad = _pow2(n_lanes) - n_lanes
         if pad:
             lvs.append(jnp.full((pad,), SENT, U64))
@@ -1279,7 +1499,7 @@ class JaxChecker:
         # the host path's frontier is a LIST of segment buffers (len >= 1;
         # see _materialize_segs); chunks never straddle segments (segment
         # sizes are chunk multiples by construction)
-        seg_len = frontier[0].voted_for.shape[0]
+        seg_len = _seg_rows(frontier[0])
         n_f_dev = jnp.asarray(n_f, I64)
         G = self.G
         n_chunks = -(-max(n_f, 1) // self.chunk)
@@ -1288,6 +1508,19 @@ class JaxChecker:
         hv, hf, hp = [], [], []  # per-group unique candidates, host-side
         mult_np = np.zeros((self.K,), np.int64)
         saved = self._load_partials(ckdir, level, n_f) if ckdir else {}
+        # host-paged segments transit through a one-entry cache: the chunk
+        # walk is ascending, so when it enters segment sj+1 the device
+        # copy of sj drops (the numpy copy in ``frontier`` stays)
+        page = {"j": -1, "dev": None}
+
+        def seg_dev(sj):
+            s = frontier[sj]
+            if not isinstance(s, _HostSeg):
+                return s
+            if page["j"] != sj:
+                page["j"], page["dev"] = sj, self._seg_to_dev(s)
+            return page["dev"]
+
         for gi in range(n_groups):
             if gi in saved:
                 z = saved[gi]
@@ -1296,40 +1529,61 @@ class JaxChecker:
                 hp.append(z["hp"])
                 mult_np += z["mult"]
                 continue
-            cvs, cfs, cps = [], [], []
             mult_acc = jnp.zeros((self.K,), I64)
             abort_at = BIG
             overflow = jnp.zeros((), bool)
-            synced = 0
-            for ci in range(gi * G, min((gi + 1) * G, n_chunks)):
-                sj, off = divmod(ci * self.chunk, seg_len)
-                part_f = jax.tree.map(
-                    lambda x: jax.lax.dynamic_slice_in_dim(
-                        x, off, self.chunk
-                    ),
-                    frontier[sj],
-                )
-                cv, cf, cp, mult_slots, ab_at, ovf = self._expand_chunk(
-                    part_f, jnp.asarray(ci * self.chunk, I64), n_f_dev
-                )
-                cvs.append(cv)
-                cfs.append(cf)
-                cps.append(cp)
-                mult_acc = mult_acc + mult_slots
-                abort_at = jnp.minimum(abort_at, ab_at)
-                overflow = overflow | ovf
-                synced += 1
-                if synced >= self.sync_every:
-                    jax.device_get(abort_at)
-                    synced = 0
-            while len(cvs) < G:  # pad the group to its fixed width
-                cvs.append(jnp.full((self.cap_x,), SENT, U64))
-                cfs.append(jnp.full((self.cap_x,), SENT, U64))
-                cps.append(jnp.full((self.cap_x,), -1, I64))
-            n_u_dev, gv, gf, gp = _group_unique(
-                jnp.concatenate(cvs), jnp.concatenate(cfs),
-                jnp.concatenate(cps),
+            # a FULL group whose G chunks sit inside one segment runs as
+            # one scanned span program (one dispatch instead of ~13*G);
+            # the tail group and small chunks keep the per-chunk path
+            g_lo, g_hi = gi * G * self.chunk, (gi + 1) * G * self.chunk
+            span_ok = (
+                self.chunk >= self.span_min_chunk
+                and (gi + 1) * G <= n_chunks
+                and g_lo // seg_len == (g_hi - 1) // seg_len
             )
+            if span_ok:
+                sj, off = divmod(g_lo, seg_len)
+                cvs_s, cfs_s, cps_s, mult_acc, abort_at, overflow = (
+                    self._expand_span(
+                        seg_dev(sj), jnp.asarray(off, I64),
+                        jnp.asarray(g_lo, I64), n_f_dev,
+                    )
+                )
+                cat_v, cat_f, cat_p = (
+                    cvs_s.reshape(-1), cfs_s.reshape(-1), cps_s.reshape(-1)
+                )
+            else:
+                cvs, cfs, cps = [], [], []
+                synced = 0
+                for ci in range(gi * G, min((gi + 1) * G, n_chunks)):
+                    sj, off = divmod(ci * self.chunk, seg_len)
+                    part_f = jax.tree.map(
+                        lambda x: jax.lax.dynamic_slice_in_dim(
+                            x, off, self.chunk
+                        ),
+                        seg_dev(sj),
+                    )
+                    cv, cf, cp, mult_slots, ab_at, ovf = self._expand_chunk(
+                        part_f, jnp.asarray(ci * self.chunk, I64), n_f_dev
+                    )
+                    cvs.append(cv)
+                    cfs.append(cf)
+                    cps.append(cp)
+                    mult_acc = mult_acc + mult_slots
+                    abort_at = jnp.minimum(abort_at, ab_at)
+                    overflow = overflow | ovf
+                    synced += 1
+                    if synced >= self.sync_every:
+                        jax.device_get(abort_at)
+                        synced = 0
+                while len(cvs) < G:  # pad the group to its fixed width
+                    cvs.append(jnp.full((self.cap_x,), SENT, U64))
+                    cfs.append(jnp.full((self.cap_x,), SENT, U64))
+                    cps.append(jnp.full((self.cap_x,), -1, I64))
+                cat_v = jnp.concatenate(cvs)
+                cat_f = jnp.concatenate(cfs)
+                cat_p = jnp.concatenate(cps)
+            n_u_dev, gv, gf, gp = _group_unique(cat_v, cat_f, cat_p)
             # fetch the FIXED-shape padded buffers and slice host-side:
             # a device-side gv[:n_u] slice would compile a fresh tiny
             # program per distinct n_u — one remote compile per group on
@@ -1575,13 +1829,12 @@ class JaxChecker:
                 lambda x: _pad_axis0(x, cap0), frontier
             )
         elif isinstance(frontier, list) and (
-            frontier[0].voted_for.shape[0] % self.chunk
+            _seg_rows(frontier[0]) % self.chunk
         ):
-            cap0 = (
-                -(-frontier[0].voted_for.shape[0] // self.chunk) * self.chunk
-            )
+            cap0 = -(-_seg_rows(frontier[0]) // self.chunk) * self.chunk
             frontier = [
-                jax.tree.map(lambda x: _pad_axis0(x, cap0), s)
+                jax.tree.map(lambda x: _pad_axis0(x, cap0),
+                             self._seg_to_dev(s))
                 for s in frontier
             ]
 
@@ -1606,6 +1859,7 @@ class JaxChecker:
                     self.cap_x *= 2
                     self.cap_g = max(self.cap_g, self.G * self.cap_x // 2)
                     self._expand_chunk = jax.jit(self._expand_chunk_impl)
+                    self._expand_span = jax.jit(self._expand_span_impl)
                 if overflow_g:
                     self.cap_g *= 2
             if abort_at < n_f:
@@ -1700,11 +1954,18 @@ class JaxChecker:
                 )
             if bad_idx >= 0:
                 if isinstance(frontier, list):
-                    L0 = frontier[0].voted_for.shape[0]
+                    L0 = _seg_rows(frontier[0])
                     bseg, boff = divmod(bad_idx, L0)
-                    bad_tree = jax.tree.map(
-                        lambda x: x[boff : boff + 1], frontier[bseg]
-                    )
+                    bsrc = frontier[bseg]
+                    if isinstance(bsrc, _HostSeg):
+                        bad_tree = Frontier(
+                            **{f: jnp.asarray(v[boff : boff + 1])
+                               for f, v in bsrc.fields.items()}
+                        )
+                    else:
+                        bad_tree = jax.tree.map(
+                            lambda x: x[boff : boff + 1], bsrc
+                        )
                 else:
                     bad_tree = jax.tree.map(
                         lambda x: x[bad_idx : bad_idx + 1], frontier

@@ -213,7 +213,11 @@ class DenseExpand:
         vq = bits[:, : uni.vp_off].reshape(B, NP, T, L, T)
         vp = bits[:, uni.vp_off : uni.aq_off].reshape(B, NP, T)
         aq = bits[:, uni.aq_off : uni.ap_off].reshape(B, NP, T, L, T + 1, E, L)
-        ap = bits[:, uni.ap_off :].reshape(B, NP, T, L, 2)
+        # AppendResp pli digit spans ap_pli_min..L (0..L under the
+        # legacy-append mutation, whose reject carries prevLogIndex - 1)
+        NPLI = uni.ap_npli
+        legacy_ae = "legacy-append" in cfg.mutations
+        ap = bits[:, uni.ap_off :].reshape(B, NP, T, NPLI, 2)
 
         # ---- per-chunk aggregates ---------------------------------------
         vq_r = vq.sum((3, 4), dtype=I32)  # [B, NP, T]
@@ -291,9 +295,18 @@ class DenseExpand:
         valid1 = (t_ax[None, None, :] > ct[:, :, None]) & (to_cnt > 0)
         dh1 = None
         if want_fp:
+            vf_delta1 = self.C_vf[:, 0] - old_vf_c
+            if "become-follower" in cfg.mutations:
+                # FollowerUpdateTerm (Raft.tla:192-197): a Follower keeps
+                # its votedFor when adopting a higher term
+                vf_delta1 = jnp.where(
+                    (role == FOLLOWER)[:, :, None, None],
+                    jnp.uint32(0),
+                    vf_delta1,
+                )
             dh1 = (
                 dmul(self.C_ct[:, None], t_ax[None, None, :] - ct[:, :, None])
-                + (dmul(self.C_role, FOLLOWER - role) + self.C_vf[:, 0] - old_vf_c)[
+                + (dmul(self.C_role, FOLLOWER - role) + vf_delta1)[
                     :, :, None
                 ]
             )
@@ -303,7 +316,11 @@ class DenseExpand:
         cnt2 = jnp.einsum("bdt,bdt->bd", aq_to_cnt, oh_ct)
         has2 = has_term & (cnt2 > 0)
         valid2 = has2 & (role == CANDIDATE)
-        abort = (has2 & (role == LEADER)).any(1)
+        if "become-follower" in cfg.mutations:
+            # the dead BecomeFollower family has no Assert (Raft.tla:228-231)
+            abort = jnp.zeros((B,), bool)
+        else:
+            abort = (has2 & (role == LEADER)).any(1)
         dh2 = dmul(self.C_role, FOLLOWER - role) if want_fp else None
         emit(valid2, cnt2, dh2)
 
@@ -436,6 +453,24 @@ class DenseExpand:
             & log_match[:, :, None, :, None, None]
             & (present7 > 0)
         )
+        # success AppendResp presence: s -> src at cur with prevLogIndex
+        # PI[l, e] — needed by the fp delta, and under legacy-append also
+        # by the guard (Raft.tla:347-348's resp∉msgs ∨ commit-advance);
+        # skipped entirely on the unmutated guards-only hot path
+        resp_present7 = None
+        if legacy_ae or want_fp:
+            oh_pi = _oh(self.PI - uni.ap_pli_min, NPLI)  # [l, e, NPLI]
+            resp_present7 = jnp.einsum(
+                "bqtj,scq,bst,lej->bscle", ap1, self.SELP, oh_ct, oh_pi
+            )
+        if legacy_ae:
+            ci_adv = (
+                self.MINLC[None, None] > ci[:, :, None, None, None]
+            )  # new_ci > ci  [B, s, l, e, h]
+            valid7 = valid7 & (
+                (resp_present7[:, :, :, :, :, None] == 0)
+                | ci_adv[:, :, None]
+            )
         dh7 = None
         if want_fp:
             # log rewrite deltas (only when `updated`)
@@ -471,13 +506,9 @@ class DenseExpand:
                 jnp.maximum(ci[:, :, None, None, None], self.MINLC[None, None])
                 - ci[:, :, None, None, None],
             )  # [B, s, l, e, h, P, C]
-            # success AppendResp s -> src at cur with prevLogIndex PI[l, e]
-            oh_pi = _oh(self.PI - 1, L)  # [l, e, L]
-            resp_present7 = jnp.einsum(
-                "bqtj,scq,bst,lej->bscle", ap1, self.SELP, oh_ct, oh_pi
-            )
             rest7 = (
-                (tcur1 - 1)[:, :, None, None] * L + (self.PI[None, None] - 1)
+                (tcur1 - 1)[:, :, None, None] * NPLI
+                + (self.PI[None, None] - uni.ap_pli_min)
             ) * 2 + 1
             dmsg7 = self._add_msg(
                 self._pair_ab[:, :, None, None],  # [s, c, 1, 1] pair(s->c)
@@ -503,7 +534,12 @@ class DenseExpand:
         cnt8 = tot8 - jnp.where(
             log_match[:, :, None, :], match8, 0
         )
-        rej_bit = jnp.einsum("bqtl,scq,bst->bscl", ap0, self.SELP, oh_ct)
+        # the reject response's pli digit per witness pli l0: live -> l0
+        # (resp pli = pli); legacy-append -> also l0, but in the widened
+        # 0..L domain (resp pli = pli - 1, digit = (pli-1) - 0) — only the
+        # block slice and the encode stride differ
+        ap0_rej = ap0 if uni.ap_pli_min == 1 else ap0[:, :, :, :L]
+        rej_bit = jnp.einsum("bqtl,scq,bst->bscl", ap0_rej, self.SELP, oh_ct)
         valid8 = (
             (role == FOLLOWER)[:, :, None, None]
             & has_term[:, :, None, None]
@@ -514,14 +550,18 @@ class DenseExpand:
         dh8 = None
         if want_fp:
             rest8 = jnp.broadcast_to(
-                ((tcur1 - 1)[:, :, None, None] * L + jnp.arange(L, dtype=I32)) * 2,
+                ((tcur1 - 1)[:, :, None, None] * NPLI + jnp.arange(L, dtype=I32))
+                * 2,
                 (B, S, S, L),
             )
             dh8 = self._add_msg(self._pair_ab[:, :, None], 3, rest8, 1 - rej_bit)
         emit(valid8, cnt8, dh8)
 
         # ---- F9 HandleAppendResp(s, src, pli, succ)  [B, s, c, l, x] -----
-        bit9 = jnp.einsum("bqtlx,csq,bst->bsclx", ap, self.SELP, oh_ct)
+        # witness pli spans 1..L either way (a pli=0 legacy reject can
+        # never satisfy the guard: pli > matchIndex >= 1, Raft.tla:392)
+        ap9 = ap if uni.ap_pli_min == 1 else ap[:, :, :, 1:]
+        bit9 = jnp.einsum("bqtlx,csq,bst->bsclx", ap9, self.SELP, oh_ct)
         pli9 = pli_ax[None, None, None, :]  # [1,1,1,l]
         mi_sc = mi[:, :, :, None]
         ni_sc = ni[:, :, :, None]

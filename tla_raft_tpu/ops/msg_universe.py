@@ -54,11 +54,17 @@ class MsgUniverse:
         self.S, self.T, self.L, self.V = S, T, L, V
         pairs = S * (S - 1)
         self.n_entry = 1 + T * V  # 0 = heartbeat, else (eterm, eval)
+        # The dead FollowerAppendEntry's reject response carries
+        # prevLogIndex - 1 (Raft.tla:364), which reaches 0 — compiling it
+        # in (--mutate legacy-append) widens the AppendResp pli domain to
+        # 0..L; the live spec's responses keep 1..L.
+        self.ap_pli_min = 0 if "legacy-append" in cfg.mutations else 1
+        self.ap_npli = L + 1 - self.ap_pli_min
 
         self.vq_size = pairs * T * L * T
         self.vp_size = pairs * T
         self.aq_size = pairs * T * L * (T + 1) * self.n_entry * L
-        self.ap_size = pairs * T * L * 2
+        self.ap_size = pairs * T * self.ap_npli * 2
         self.vq_off = 0
         self.vp_off = self.vq_off + self.vq_size
         self.aq_off = self.vp_off + self.vp_size
@@ -75,7 +81,7 @@ class MsgUniverse:
             T * L * T,  # VoteReq block per (src, dst)
             T,  # VoteResp
             T * L * (T + 1) * self.n_entry * L,  # AppendReq
-            T * L * 2,  # AppendResp
+            T * self.ap_npli * 2,  # AppendResp
         )
 
         self._build_decode_tables()
@@ -102,9 +108,11 @@ class MsgUniverse:
         return self.aq_off + x
 
     def encode_appendresp(self, src, dst, term, pli, succ):
-        S, T, L = self.S, self.T, self.L
+        S, T = self.S, self.T
         di = _dst_idx(src, dst)
-        x = (((src - 1) * (S - 1) + di) * T + (term - 1)) * L + (pli - 1)
+        x = (((src - 1) * (S - 1) + di) * T + (term - 1)) * self.ap_npli + (
+            pli - self.ap_pli_min
+        )
         return self.ap_off + x * 2 + succ
 
     def entry_code(self, eterm, eval_):
@@ -161,13 +169,15 @@ class MsgUniverse:
         entry[ids] = g[5].ravel()
         lc[ids] = g[6].ravel() + 1
         # AppendResp
-        g = grid(S, S - 1, T, L, 2)
-        ids = self.ap_off + np.ravel_multi_index([x.ravel() for x in g], (S, S - 1, T, L, 2))
+        g = grid(S, S - 1, T, self.ap_npli, 2)
+        ids = self.ap_off + np.ravel_multi_index(
+            [x.ravel() for x in g], (S, S - 1, T, self.ap_npli, 2)
+        )
         typ[ids] = APPEND_RESP
         src[ids] = g[0].ravel() + 1
         dst[ids] = _dst_from_idx(g[0].ravel() + 1, g[1].ravel())
         term[ids] = g[2].ravel() + 1
-        pli[ids] = g[3].ravel() + 1
+        pli[ids] = g[3].ravel() + self.ap_pli_min
         succ[ids] = g[4].ravel()
 
         self.typ, self.src, self.dst, self.term = typ, src, dst, term
@@ -318,7 +328,8 @@ class MsgUniverse:
                             self.entry, np.maximum(self.lc, 1),
                         ),
                         self.encode_appendresp(
-                            ns, nd, self.term, np.maximum(self.pli, 1), self.succ
+                            ns, nd, self.term,
+                            np.maximum(self.pli, self.ap_pli_min), self.succ,
                         ),
                     ),
                 ),

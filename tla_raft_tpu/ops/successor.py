@@ -253,16 +253,27 @@ class SuccessorKernel:
             return out
 
         # (name, scalar fn, witness coords [W, 5]); coord 0 is always s.
+        # Mutation swaps keep the slot grid identical — the dead actions'
+        # witness spaces coincide with their live successors' — only the
+        # scalar semantics and trace names change (SURVEY.md §4.4).
+        ut_name = (
+            "BecomeFollower"
+            if "become-follower" in cfg.mutations
+            else "UpdateTerm"
+        )
+        legacy_ae = "legacy-append" in cfg.mutations
         self.families = [
             ("BecomeCandidate", self._become_candidate, pad5(grid(S))),
-            ("UpdateTerm", self._update_term_a, pad5(grid(S, T))),
-            ("UpdateTerm", self._update_term_b, pad5(grid(S))),
+            (ut_name, self._update_term_a, pad5(grid(S, T))),
+            (ut_name, self._update_term_b, pad5(grid(S))),
             ("ResponseVote", self._response_vote, pad5(grid(S, S))),
             ("BecomeLeader", self._become_leader, pad5(grid(S))),
             ("ClientReq", self._client_req, pad5(grid(S, V))),
             ("LeaderAppendEntry", self._leader_append, pad5(grid(S, S))),
-            ("FollowerAcceptEntry", self._follower_accept, pad5(grid(S, S, L, E, L))),
-            ("FollowerRejectEntry", self._follower_reject, pad5(grid(S, S, L))),
+            ("FollowerAppendEntry" if legacy_ae else "FollowerAcceptEntry",
+             self._follower_accept, pad5(grid(S, S, L, E, L))),
+            ("FollowerAppendEntry" if legacy_ae else "FollowerRejectEntry",
+             self._follower_reject, pad5(grid(S, S, L))),
             ("HandleAppendResp", self._handle_append_resp, pad5(grid(S, S, L, 2))),
             ("LeaderCanCommit", self._leader_can_commit, pad5(grid(S))),
             ("Restart", self._restart, pad5(grid(S))),
@@ -336,10 +347,20 @@ class SuccessorKernel:
         mask = self.tables.any_to[s, t - 1]
         hit = _any(st.msgs, mask)
         valid = (t > cur) & hit
+        # the "become-follower" mutation compiles the dead BecomeFollower
+        # family (Raft.tla:191-231): a Follower adopting a higher term
+        # KEEPS its votedFor (FollowerUpdateTerm, Raft.tla:192-197);
+        # Candidate/Leader reset it as in the live UpdateTerm
+        if "become-follower" in self.cfg.mutations:
+            new_vf = jnp.where(
+                st.role[s] == FOLLOWER, _get1(st.voted_for, s), 0
+            )
+        else:
+            new_vf = 0
         child = st._replace(
             role=_set1(st.role, s, FOLLOWER),
             current_term=_set1(st.current_term, s, t),
-            voted_for=_set1(st.voted_for, s, 0),
+            voted_for=_set1(st.voted_for, s, new_vf),
         )
         return valid, _popcount(st.msgs, mask), child, self._no_add(), False
 
@@ -350,7 +371,10 @@ class SuccessorKernel:
         has = (cur >= 1) & _any(st.msgs, mask)
         role = st.role[s]
         valid = has & (role == CANDIDATE)
-        abort = has & (role == LEADER)  # Assert "split brain", Raft.tla:185
+        if "become-follower" in self.cfg.mutations:
+            abort = False  # the dead family has no Assert (Raft.tla:228-231)
+        else:
+            abort = has & (role == LEADER)  # Assert "split brain", Raft.tla:185
         child = st._replace(role=_set1(st.role, s, FOLLOWER))
         return valid, _popcount(st.msgs, mask), child, self._no_add(), abort
 
@@ -500,18 +524,22 @@ class SuccessorKernel:
         new_lt = jnp.where(at_entry, eterm, new_lt)
         new_lv = jnp.where(keep, lv, 0)
         new_lv = jnp.where(at_entry, eval_, new_lv)
+        old_ci = _get1(st.commit_index, s)
+        new_ci = jnp.maximum(old_ci, jnp.minimum(lc, new_len))
         child = st._replace(
             log_term=_set_row(st.log_term, s, jnp.where(updated, new_lt, lt)),
             log_val=_set_row(st.log_val, s, jnp.where(updated, new_lv, lv)),
             log_len=_set1(st.log_len, s, jnp.where(updated, new_len, ll)),
-            commit_index=_set1(
-                st.commit_index, s,
-                jnp.maximum(_get1(st.commit_index, s), jnp.minimum(lc, new_len)),
-            ),
+            commit_index=_set1(st.commit_index, s, new_ci),
         )
         resp = uni.encode_appendresp(
             s + 1, src + 1, jnp.clip(cur, 1, T), jnp.clip(pli + el, 1, L), 1
         ).astype(I32)
+        if "legacy-append" in cfg.mutations:
+            # the dead monolithic FollowerAppendEntry gates its accept on
+            # resp \notin msgs \/ commit-advance (Raft.tla:347-348); the
+            # live FollowerAcceptEntry has no send-guard
+            valid = valid & (~_bit_get(st.msgs, resp) | (new_ci > old_ci))
         return valid, I32(1), child, _set1(self._no_add(), 0, resp), False
 
     def _follower_reject(self, st: RaftState, c):
@@ -525,8 +553,11 @@ class SuccessorKernel:
         match_plt = jnp.clip(st.log_term.astype(I32)[s, jnp.clip(pli - 1, 0, L - 1)], 0, T)
         sub = self.tables.aq_plt[src, s, tix, pli - 1, match_plt]
         qual = jnp.where(pli <= ll, block & ~sub, block)
+        # the dead FollowerAppendEntry's reject carries prevLogIndex - 1
+        # (Raft.tla:364) vs the live :314's unchanged value
+        rej_pli = pli - 1 if "legacy-append" in cfg.mutations else pli
         rej = uni.encode_appendresp(
-            s + 1, src + 1, jnp.clip(cur, 1, T), pli, 0
+            s + 1, src + 1, jnp.clip(cur, 1, T), rej_pli, 0
         ).astype(I32)
         valid = (
             (st.role[s] == FOLLOWER) & (cur >= 1) & (src != s)

@@ -171,6 +171,61 @@ def update_term(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, t
                 yield (st._replace(role=_replace_server(st.role, s, FOLLOWER)), (m,))
 
 
+def become_follower_legacy(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
+    """BecomeFollower(s) — the dead predecessor of UpdateTerm
+    (Raft.tla:228-231 disjoining Raft.tla:191-225), compiled in by
+    ``--mutate become-follower``.  Deltas vs the live UpdateTerm:
+
+    * ``FollowerUpdateTerm`` (Raft.tla:191-197): a Follower adopting a
+      higher term KEEPS its votedFor (no reset — the stale vote carries
+      into the new term) and updates currentTerm only.
+    * no split-brain ``Assert`` anywhere — a Leader receiving a same-term
+      AppendReq simply matches no branch (the live spec aborts,
+      Raft.tla:185).
+    """
+    role = st.role[s - 1]
+    cur = st.current_term[s - 1]
+    for m in st.msgs:
+        if m[2] != s:  # m.dst = s
+            continue
+        term = m[3]
+        if role == FOLLOWER:
+            if term > cur:  # FollowerUpdateTerm, Raft.tla:192-197
+                yield (
+                    st._replace(
+                        current_term=_replace_server(st.current_term, s, term)
+                    ),
+                    (m,),
+                )
+        elif role == CANDIDATE:
+            # CandidateToFollower, Raft.tla:200-213
+            if term > cur:
+                yield (
+                    st._replace(
+                        current_term=_replace_server(st.current_term, s, term),
+                        role=_replace_server(st.role, s, FOLLOWER),
+                        voted_for=_replace_server(st.voted_for, s, NONE),
+                    ),
+                    (m,),
+                )
+            if term == cur and m[0] == APPEND_REQ:
+                yield (
+                    st._replace(role=_replace_server(st.role, s, FOLLOWER)),
+                    (m,),
+                )
+        elif role == LEADER:
+            # LeaderToFollower, Raft.tla:216-225
+            if term > cur:
+                yield (
+                    st._replace(
+                        current_term=_replace_server(st.current_term, s, term),
+                        role=_replace_server(st.role, s, FOLLOWER),
+                        voted_for=_replace_server(st.voted_for, s, NONE),
+                    ),
+                    (m,),
+                )
+
+
 def response_vote(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
     """ResponseVote(s) — Raft.tla:132-155. Grant-only, exact-term."""
     if st.role[s - 1] != FOLLOWER:
@@ -334,6 +389,53 @@ def follower_reject_entry(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple
         yield (st._replace(msgs=st.msgs | {reject}), (m,))
 
 
+def follower_append_entry_legacy(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
+    """FollowerAppendEntry(s) — the dead monolithic accept+reject variant
+    (Raft.tla:323-371), compiled in by ``--mutate legacy-append``.
+    Deltas vs the live FollowerAcceptEntry/FollowerRejectEntry pair:
+
+    * the reject response carries ``prevLogIndex - 1`` (Raft.tla:364 vs
+      the live ``:314``'s unchanged value) — the leader's backoff walks
+      one index further per round, changing reachability;
+    * the accept branch is gated by ``resp \\notin msgs \\/ newCommitIndex
+      > commitIndex[s]`` (Raft.tla:347-348) where the live accept has no
+      send-guard at all (its re-fire is a harmless self-loop).
+    """
+    if st.role[s - 1] != FOLLOWER:
+        return
+    cur = st.current_term[s - 1]
+    log = st.logs[s - 1]
+    for m in st.msgs:
+        if m[0] != APPEND_REQ or m[2] != s or m[3] != cur:
+            continue
+        _, src, _, term, pli, plt, entries, leader_commit = m
+        if _log_match(st, s, pli, plt):
+            resp = (APPEND_RESP, s, src, term, pli + len(entries), True)
+            new_log = log[:pli] + entries
+            append_new = len(new_log) > len(log)
+            truncated = len(new_log) <= len(log) and new_log != log[: len(new_log)]
+            new_commit = max(
+                st.commit_index[s - 1], min(leader_commit, len(new_log))
+            )
+            # re-enable disjunct, Raft.tla:347-348
+            if resp in st.msgs and new_commit <= st.commit_index[s - 1]:
+                continue
+            updated_log = new_log if (truncated or append_new) else log
+            yield (
+                st._replace(
+                    msgs=st.msgs | {resp},
+                    commit_index=_replace_server(st.commit_index, s, new_commit),
+                    logs=_replace_server(st.logs, s, updated_log),
+                ),
+                (m,),
+            )
+        else:
+            reject = (APPEND_RESP, s, src, term, pli - 1, False)  # :364
+            if reject in st.msgs:
+                continue
+            yield (st._replace(msgs=st.msgs | {reject}), (m,))
+
+
 def handle_append_resp(cfg: RaftConfig, st: OState, s: int) -> Iterable[tuple[OState, tuple]]:
     """HandleAppendResp(s) — Raft.tla:374-396."""
     if st.role[s - 1] != LEADER:
@@ -422,14 +524,29 @@ ACTIONS: tuple[tuple[str, Callable], ...] = (
 )
 
 
+def actions_for(cfg: RaftConfig) -> tuple[tuple[str, Callable], ...]:
+    """The Next disjunction with any planted-mutation swaps applied
+    (SURVEY.md §4.4: the dead actions are the reference's ready-made
+    checker tests — compile one in and the checker must notice)."""
+    acts = list(ACTIONS)
+    if "become-follower" in cfg.mutations:
+        acts[1] = ("BecomeFollower", become_follower_legacy)
+    if "legacy-append" in cfg.mutations:
+        # the monolithic variant replaces the live accept/reject pair
+        acts[6] = ("FollowerAppendEntry", follower_append_entry_legacy)
+        del acts[7]
+    return tuple(acts)
+
+
 def successors(cfg: RaftConfig, st: OState) -> list[tuple[str, int, tuple, OState]]:
     """All successors of ``Next`` (Raft.tla:416-430): action × server × witness.
 
     Raises SplitBrainAbort if the embedded Assert fires (Raft.tla:185).
     """
     out = []
+    acts = actions_for(cfg)
     for s in range(1, cfg.S + 1):
-        for name, fn in ACTIONS:
+        for name, fn in acts:
             for nxt, detail in fn(cfg, st, s):
                 out.append((name, s, detail, nxt))
     return out

@@ -72,6 +72,37 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.array(devs), axis_names=("d",))
 
 
+class Phase1Out(NamedTuple):
+    """Host-store mode, phase 1: expand + local pre-dedup + owner routing.
+
+    The visited filter moves OFF the device (per-owner external stores,
+    native/fpstore.cpp) — phase 1 stops after the routing ``all_to_all``;
+    the host filters each owner's level-unique candidates through its
+    store shard; phase 2 carries the verdicts back and materializes."""
+
+    cv: jnp.ndarray  # u64[cap_x] compacted local candidates (origin side)
+    cf: jnp.ndarray  # u64[cap_x]
+    cp: jnp.ndarray  # i64[cap_x]
+    rv: jnp.ndarray  # u64[D, cap_r] owner-side recv (fp_view)
+    rf: jnp.ndarray  # u64[D, cap_r]
+    rp: jnp.ndarray  # i64[D, cap_r]
+    mult_slots: jnp.ndarray  # i64[K] psum'd per-slot fired counts
+    abort: jnp.ndarray  # bool[] any split-brain abort (psum'd)
+    abort_at: jnp.ndarray  # i64[1]
+    overflow_x: jnp.ndarray  # bool[] candidate/routing capacity exceeded
+
+
+class Phase2Out(NamedTuple):
+    children: RaftState
+    child_msum: jnp.ndarray
+    n_new_local: jnp.ndarray  # i64[1]
+    n_new_total: jnp.ndarray  # i64[]
+    gpidx: jnp.ndarray
+    slots: jnp.ndarray
+    inv_bad: jnp.ndarray
+    inv_bad_at: jnp.ndarray  # i64[1]
+
+
 class LevelOut(NamedTuple):
     """Per-device outputs of one distributed BFS level (shard_map body)."""
 
@@ -132,8 +163,25 @@ class ShardedChecker:
         exchange: str = "all_to_all",
         progress=None,
         canon: str = "late",
+        host_store_dir: str | None = None,
     ):
         assert exchange in ("all_to_all", "all_gather")
+        # mesh x external store (VERDICT r3 missing #4 / next #6): the
+        # visited set leaves the devices entirely — one HostFPStore per
+        # owner shard (fp % D keying matches the all_to_all routing), the
+        # host filters after the routing exchange.  North-star configs
+        # exceed D*HBM on small meshes; this is TLC's states/ spill
+        # composed with its worker pool (/root/reference/.gitignore:2).
+        if host_store_dir is not None:
+            if exchange != "all_to_all":
+                raise ValueError(
+                    "host_store_dir requires exchange='all_to_all' (the "
+                    "store is owner-sharded by fp % D)"
+                )
+            if canon != "late":
+                raise ValueError("host_store_dir requires canon='late'")
+        self.host_store_dir = host_store_dir
+        self.host_stores = None  # built lazily in run()
         # canon="late" (default): guards-only expand, then materialize +
         # full-state-fingerprint only the compacted candidates — no
         # P-sized per-lane intermediates and no per-state msum carried in
@@ -351,6 +399,184 @@ class ShardedChecker:
             jax.lax.psum(overflow_v.astype(I32), "d") > 0,
         )
 
+    # -- host-store mode: the level split into two collective programs ----
+
+    def _body_a2a_phase1(self, frontier, msum, n_f):
+        """Expand + local pre-dedup + route to owners; no visited filter."""
+        D, cap_x, cap_r = self.D, self.cap_x, self.cap_r
+        (cv, cf, cp, mult_slots, abort, abort_at, overflow, _dev, _cap_f) = (
+            self._expand_local(frontier, msum, n_f)
+        )
+        owner = jnp.where(cv == SENT, D, (cv % jnp.uint64(D)).astype(I64))
+        oorder = jnp.argsort(owner, stable=True)
+        ov, of_, op, oo = cv[oorder], cf[oorder], cp[oorder], owner[oorder]
+        counts = jnp.bincount(oo, length=D + 1)
+        starts = jnp.cumsum(counts) - counts
+        overflow_x = overflow | (counts[:D].max() > cap_r)
+        idx = jnp.clip(
+            starts[:D, None] + jnp.arange(cap_r, dtype=starts.dtype)[None, :],
+            0,
+            cap_x - 1,
+        )
+        in_row = jnp.arange(cap_r)[None, :] < counts[:D, None]
+        sendv = jnp.where(in_row, ov[idx], SENT)
+        sendf = jnp.where(in_row, of_[idx], SENT)
+        sendp = jnp.where(in_row, op[idx], -1)
+        rv = jax.lax.all_to_all(sendv, "d", 0, 0, tiled=True).reshape(D, cap_r)
+        rf = jax.lax.all_to_all(sendf, "d", 0, 0, tiled=True).reshape(D, cap_r)
+        rp = jax.lax.all_to_all(sendp, "d", 0, 0, tiled=True).reshape(D, cap_r)
+        return Phase1Out(
+            cv, cf, cp, rv, rf, rp, mult_slots, abort, abort_at[None],
+            jax.lax.psum(overflow_x.astype(I32), "d") > 0,
+        )
+
+    def _body_a2a_phase2(self, frontier, cv, cp, verdict_recv, n_f):
+        """Verdicts back to origins; compact winners; materialize.
+
+        The owner grouping is recomputed from ``cv`` — ``argsort`` over
+        the same input is deterministic, so the lanes line up with the
+        phase-1 send layout exactly."""
+        D, cap_x, cap_r = self.D, self.cap_x, self.cap_r
+        dev = jax.lax.axis_index("d").astype(I64)
+        cap_f = frontier.voted_for.shape[0]
+        owner = jnp.where(cv == SENT, D, (cv % jnp.uint64(D)).astype(I64))
+        oorder = jnp.argsort(owner, stable=True)
+        op, oo = cp[oorder], owner[oorder]
+        counts = jnp.bincount(oo, length=D + 1)
+        starts = jnp.cumsum(counts) - counts
+        rank = jnp.arange(cap_x) - starts[oo]
+        rr = jnp.clip(rank, 0, cap_r - 1)
+        ok_lane = (cv[oorder] != SENT) & (rank < cap_r)
+        back = jax.lax.all_to_all(
+            verdict_recv, "d", 0, 0, tiled=True
+        ).reshape(D, cap_r)
+        win_sorted = back[jnp.clip(oo, 0, D - 1), rr] & ok_lane
+        n_new_local = win_sorted.sum().astype(I64)
+        n_new_total = jax.lax.psum(n_new_local, "d")
+        wpay, wlane = _compact(win_sorted, cap_x, op, fills=(I64(0),))
+        children, child_msum, gpidx, slots, inv_bad, first_bad = (
+            self._children_from(frontier, cap_f, dev, wpay, wlane)
+        )
+        return Phase2Out(
+            children, child_msum, n_new_local[None], n_new_total,
+            gpidx, jnp.where(wlane, slots, -1), inv_bad, first_bad[None],
+        )
+
+    def _host_filter(self, rv, rf, rp):
+        """Filter each owner's recv buffer through its external store.
+
+        Mirrors the device dedup exactly: lexsort (payload, fp_full,
+        fp_view), first-occurrence per fp_view is the representative
+        (min (fp_full, payload) — the deterministic refinement every
+        engine of this project pins), then the store's is-new verdict.
+        Returns (verdict [D, D, cap_r] aligned to the recv layout,
+        n_new_total)."""
+        D, cap_r = self.D, self.cap_r
+        sent = np.uint64(0xFFFFFFFFFFFFFFFF)
+        rv = np.asarray(rv).reshape(D, D * cap_r)
+        rf = np.asarray(rf).reshape(D, D * cap_r)
+        rp = np.asarray(rp).reshape(D, D * cap_r)
+        verdict = np.zeros((D, D * cap_r), bool)
+        n_new = 0
+        for o in range(D):
+            order = np.lexsort((rp[o], rf[o], rv[o]))
+            sv = rv[o][order]
+            first = np.concatenate([[True], sv[1:] != sv[:-1]]) & (sv != sent)
+            uniq = sv[first]
+            if len(uniq):
+                is_new = self.host_stores[o].insert(uniq)
+            else:
+                is_new = np.zeros(0, bool)
+            vs = np.zeros(D * cap_r, bool)
+            vs[first] = is_new
+            verdict[o][order] = vs
+            n_new += int(is_new.sum())
+        return verdict.reshape(D, D, cap_r), n_new
+
+    @functools.cached_property
+    def level_phase1(self):
+        spec_state = jax.tree.map(lambda _: P("d"), init_batch(self.cfg, 1))
+        return jax.jit(
+            jax.shard_map(
+                self._body_a2a_phase1,
+                mesh=self.mesh,
+                in_specs=(spec_state, P("d"), P("d")),
+                out_specs=Phase1Out(
+                    P("d"), P("d"), P("d"), P("d"), P("d"), P("d"),
+                    P(), P(), P("d"), P(),
+                ),
+                check_vma=False,
+            )
+        )
+
+    @functools.cached_property
+    def level_phase2(self):
+        spec_state = jax.tree.map(lambda _: P("d"), init_batch(self.cfg, 1))
+        return jax.jit(
+            jax.shard_map(
+                self._body_a2a_phase2,
+                mesh=self.mesh,
+                in_specs=(spec_state, P("d"), P("d"), P("d"), P("d")),
+                out_specs=Phase2Out(
+                    jax.tree.map(lambda _: P("d"), init_batch(self.cfg, 1)),
+                    P("d"), P("d"), P(), P("d"), P("d"), P(), P("d"),
+                ),
+                check_vma=False,
+            )
+        )
+
+    def _hosted_level(self, frontier, msum, n_f):
+        """One BFS level in host-store mode: phase 1 (expand + route),
+        host filter through the per-owner external stores, phase 2
+        (verdicts back + materialize).  Returns a LevelOut-shaped
+        namespace for the shared driver loop."""
+        from types import SimpleNamespace
+
+        grows = 0
+        while True:
+            p1 = self.level_phase1(frontier, msum, n_f)
+            if not bool(p1.overflow_x):
+                break
+            if grows >= 8:
+                raise RuntimeError(
+                    f"capacity overflow (cap_x={self.cap_x}, "
+                    f"cap_r={self.cap_r})"
+                )
+            grows += 1
+            self.cap_x *= 2
+            for k in ("level_phase1", "level_phase2", "cap_r"):
+                self.__dict__.pop(k, None)
+        generated = p1.mult_slots.sum()
+        common = dict(
+            mult_slots=p1.mult_slots, generated=generated, visited=None,
+            abort=p1.abort, abort_at=p1.abort_at,
+            overflow_x=jnp.zeros((), bool), overflow_v=jnp.zeros((), bool),
+        )
+        if bool(p1.abort):
+            return SimpleNamespace(
+                n_new_total=jnp.asarray(0, I64), children=None,
+                child_msum=None, n_new_local=None, gpidx=None, slots=None,
+                inv_bad=jnp.asarray(0, I32), inv_bad_at=None, **common,
+            )
+        verdict, n_new = self._host_filter(p1.rv, p1.rf, p1.rp)
+        vr = jax.device_put(
+            jnp.asarray(verdict.reshape(self.D * self.D, self.cap_r)),
+            NamedSharding(self.mesh, P("d")),
+        )
+        p2 = self.level_phase2(frontier, p1.cv, p1.cp, vr, n_f)
+        n2 = int(np.asarray(p2.n_new_total))
+        if n2 != n_new:
+            raise RuntimeError(
+                f"host-store verdict mismatch: stores admitted {n_new} "
+                f"new states, phase 2 materialized {n2}"
+            )
+        return SimpleNamespace(
+            children=p2.children, child_msum=p2.child_msum,
+            n_new_local=p2.n_new_local, n_new_total=p2.n_new_total,
+            gpidx=p2.gpidx, slots=p2.slots,
+            inv_bad=p2.inv_bad, inv_bad_at=p2.inv_bad_at, **common,
+        )
+
     @functools.cached_property
     def cap_r(self) -> int:
         # routing capacity per (src, dst) pair: uniform hashing concentrates
@@ -543,7 +769,18 @@ class ShardedChecker:
                 f"mdelta replay rebuilt {len(fps)} distinct fingerprints "
                 f"for {distinct} recorded states — corrupt or mixed log"
             )
-        if self.exchange == "all_to_all":
+        if self.host_stores is not None:
+            # the replay rebuilds the EXTERNAL stores: clear first (they
+            # may hold pre-crash inserts, including a partially-completed
+            # level that never reached the log — those would silently mark
+            # reachable states as visited), then insert each owner's fps
+            for o, s in enumerate(self.host_stores):
+                s.clear()
+                own = np.sort(fps[fps % np.uint64(D) == o])
+                if len(own):
+                    s.insert(own)
+            visited = None
+        elif self.exchange == "all_to_all":
             per_shard = [np.sort(fps[fps % np.uint64(D) == o]) for o in range(D)]
             need = max(len(s) for s in per_shard)
             vcap = max(self.vcap, 1 << (2 * need - 1).bit_length())
@@ -645,6 +882,17 @@ class ShardedChecker:
         repl = NamedSharding(mesh, P())
         t0 = time.monotonic()
 
+        if self.host_store_dir is not None and self.host_stores is None:
+            from ..native import HostFPStore
+
+            self.host_stores = [
+                HostFPStore(os.path.join(self.host_store_dir, f"shard_{o:02d}"))
+                for o in range(D)
+            ]
+            if resume_from is None:
+                for s in self.host_stores:
+                    s.clear()  # orphaned run files from a crashed process
+
         if checkpoint_dir and checkpoint_every:
             import glob as _glob
 
@@ -687,7 +935,12 @@ class ShardedChecker:
             msum = jax.device_put(msum0, shard)
             n_f = jax.device_put(jnp.asarray([1] + [0] * (D - 1), I64), shard)
             fp0 = np.asarray(fv.astype(U64))[0]
-            if self.exchange == "all_to_all":
+            if self.host_stores is not None:
+                self.host_stores[int(fp0 % D)].insert(
+                    np.asarray([fp0], np.uint64)
+                )
+                visited = None
+            elif self.exchange == "all_to_all":
                 vis = np.full((D, self.vcap), np.uint64(0xFFFFFFFFFFFFFFFF))
                 vis[int(fp0 % D), 0] = fp0
                 vis = np.sort(vis, axis=1)
@@ -726,30 +979,33 @@ class ShardedChecker:
         while True:
             if max_depth is not None and depth >= max_depth:
                 break
-            if self.exchange == "all_to_all" and distinct > D * self.vcap // 2:
-                visited = grow_visited(visited, self.vcap * 4)
-            # the level step is pure, so failed (overflowed) outputs drop
-            # and the retry recomputes the level at the grown capacity
-            grows = 0
-            while True:
-                out = self.level_step(frontier, msum, n_f, visited)
-                if not (bool(out.overflow_v) or bool(out.overflow_x)):
-                    break
-                if grows >= 8:
-                    raise RuntimeError(
-                        f"capacity overflow at level {depth + 1} "
-                        f"(cap_x={self.cap_x}, cap_r={self.cap_r}, "
-                        f"vcap={self.vcap})"
-                    )
-                grows += 1
-                if bool(out.overflow_v):
+            if self.host_stores is not None:
+                out = self._hosted_level(frontier, msum, n_f)
+            else:
+                if self.exchange == "all_to_all" and distinct > D * self.vcap // 2:
                     visited = grow_visited(visited, self.vcap * 4)
-                else:
-                    # candidate compaction / routing lanes overflowed: grow
-                    # cap_x (recompiles the level step — rare)
-                    self.cap_x *= 2
-                    self.__dict__.pop("level_step", None)
-                    self.__dict__.pop("cap_r", None)
+                # the level step is pure, so failed (overflowed) outputs
+                # drop and the retry recomputes at the grown capacity
+                grows = 0
+                while True:
+                    out = self.level_step(frontier, msum, n_f, visited)
+                    if not (bool(out.overflow_v) or bool(out.overflow_x)):
+                        break
+                    if grows >= 8:
+                        raise RuntimeError(
+                            f"capacity overflow at level {depth + 1} "
+                            f"(cap_x={self.cap_x}, cap_r={self.cap_r}, "
+                            f"vcap={self.vcap})"
+                        )
+                    grows += 1
+                    if bool(out.overflow_v):
+                        visited = grow_visited(visited, self.vcap * 4)
+                    else:
+                        # candidate compaction / routing lanes overflowed:
+                        # grow cap_x (recompiles the level step — rare)
+                        self.cap_x *= 2
+                        self.__dict__.pop("level_step", None)
+                        self.__dict__.pop("cap_r", None)
             if bool(out.abort):
                 # locate the aborting parent (a current-frontier state) and
                 # replay its slot chain, exactly like the single-device path
@@ -778,13 +1034,14 @@ class ShardedChecker:
                 (np.asarray(out.gpidx).astype(np.int64),
                  np.asarray(out.slots).astype(np.int64))
             )
-            visited = out.visited
-            if self.exchange == "all_gather":
-                # the replicated store grows by D*cap_x sentinel-padded slots
-                # per level; trim back to the tightest pow2 that holds every
-                # distinct fingerprint (store is sorted, SENT-padded)
-                keep = max(4096, 1 << distinct.bit_length())
-                visited = jax.device_put(out.visited[:keep], repl)
+            if self.host_stores is None:
+                visited = out.visited
+                if self.exchange == "all_gather":
+                    # the replicated store grows by D*cap_x sentinel-padded
+                    # slots per level; trim back to the tightest pow2 that
+                    # holds every distinct fp (store is sorted, SENT-padded)
+                    keep = max(4096, 1 << distinct.bit_length())
+                    visited = jax.device_put(out.visited[:keep], repl)
             frontier, msum = out.children, out.child_msum
             n_f = jax.device_put(out.n_new_local, shard)
             if self.progress is not None:

@@ -9,9 +9,18 @@ input: it extracts the structural skeleton (constants, variables, the
 verifies it against what the kernels implement, so a drifted or edited
 spec fails loudly instead of being silently mischecked.
 
-This is deliberately regex-level structure extraction, not a TLA+
-parser: it must accept exactly the reference spec and reject structural
-deviations from it.
+Two tiers of validation:
+
+* **structural** — constants, variables, view tuple, Next disjuncts and
+  the Inv binding must match what the kernels compile;
+* **semantic** — every top-level definition body (comment-stripped,
+  whitespace-normalized) must hash to the pinned value it had when the
+  kernels were differentially validated (``SEMANTIC_HASHES``), so an
+  edited conjunct *inside* an action — a flipped comparison, a changed
+  bound — fails validation even though the skeleton is untouched.
+
+This is deliberately regex-level extraction, not a TLA+ parser: it must
+accept exactly the reference spec and reject deviations from it.
 """
 
 from __future__ import annotations
