@@ -1,0 +1,53 @@
+#!/bin/bash
+# Round-4 on-chip measurement campaign, in priority order.  Each step is
+# independently resumable; artifacts land in docs/.  Run only when the
+# TPU tunnel is up (bench.py's init retry + watchdog handles flakes, but
+# a dead tunnel wastes ~30 min per step timing out).
+#
+# Usage: scripts/chip_campaign.sh [step...]   (default: all)
+set -u
+cd "$(dirname "$0")/.."
+steps=("${@:-fix1 fix2 s3 s5}")
+
+fail=0
+
+run_bench() {  # run_bench <outfile> [ENV=VAL ...]
+  local out="$1"; shift
+  echo "=== bench -> $out  ($*)" >&2
+  env "$@" python bench.py > "$out.tmp" 2> "$out.log"
+  local rc=$?
+  if [ "$rc" -eq 0 ]; then
+    mv "$out.tmp" "$out"  # never clobber a good artifact with a failure
+  else
+    echo "step failed (rc=$rc); partial output left at $out.tmp" >&2
+    fail=1
+  fi
+  tail -c 400 "$out.tmp" "$out" 2>/dev/null >&2; echo >&2
+  return $rc
+}
+
+for s in ${steps[@]}; do
+  case "$s" in
+    fix1)  # completed fixpoint, pinned golden total (GOLDEN_FULL gate)
+      run_bench docs/BENCH_FIX_V1MR1_r04.json \
+        BENCH_MAX_DEPTH=0 BENCH_VALS=1 BENCH_MAX_ELECTION=2 \
+        BENCH_MAX_RESTART=1 BENCH_NATIVE_DEPTH=35 ;;
+    fix2)
+      run_bench docs/BENCH_FIX_V1MR2_r04.json \
+        BENCH_MAX_DEPTH=0 BENCH_VALS=1 BENCH_MAX_ELECTION=2 \
+        BENCH_MAX_RESTART=2 BENCH_NATIVE_DEPTH=36 ;;
+    s3)    # the headline: reference config depth-19, warm spans
+      run_bench docs/BENCH_S3_r04.json ;;
+    s3big) # bigger chunk variant
+      run_bench docs/BENCH_S3_c16k_r04.json BENCH_CHUNK=16384 ;;
+    s5)    # scale config 3 (warm steady-state — run s5 twice; the
+           # second run reads the persistent compile cache)
+      run_bench docs/BENCH_S5_r04.json BENCH_SERVERS=5 BENCH_MAX_DEPTH=16 ;;
+    s7)    # scale config 5 (depth 9 — deeper than r2's 8 for a warmer rate)
+      run_bench docs/BENCH_S7_r04.json BENCH_SERVERS=7 BENCH_MAX_DEPTH=9 ;;
+    sweep) # deep-sweep continuation: level 29+ under host paging
+      scripts/run_sweep.sh || fail=1 ;;
+    *) echo "unknown step: $s" >&2; exit 2 ;;
+  esac
+done
+exit $fail

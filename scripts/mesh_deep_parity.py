@@ -15,12 +15,11 @@ import json
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in xla:
-    os.environ["XLA_FLAGS"] = (
-        xla + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tla_raft_tpu.xla_env import ensure_virtual_cpu_mesh  # noqa: E402
+
+ensure_virtual_cpu_mesh(8)
 
 import jax  # noqa: E402
 
@@ -36,11 +35,15 @@ def main():
     from tla_raft_tpu.cfgparse import load_raft_config
     from tla_raft_tpu.parallel import ShardedChecker, make_mesh
 
+    import glob
+
     depth = int(sys.argv[1]) if len(sys.argv) > 1 else 14
     ckdir = sys.argv[2] if len(sys.argv) > 2 else "/tmp/mesh_deep_ck"
     os.makedirs(ckdir, exist_ok=True)
-    for f in os.listdir(ckdir):
-        os.unlink(os.path.join(ckdir, f))
+    resumable = sorted(glob.glob(os.path.join(ckdir, "mdelta_*.npz")))
+    if not resumable:
+        for f in os.listdir(ckdir):
+            os.unlink(os.path.join(ckdir, f))
 
     cfg = load_raft_config("/root/reference/Raft.cfg")
     mesh = make_mesh(8)
@@ -53,12 +56,27 @@ def main():
               f"distinct {s['distinct']}, {s['elapsed']:.0f}s",
               file=sys.stderr, flush=True)
 
-    # phase 1: run to depth-4 short of the target, checkpointing
-    chk = ShardedChecker(cfg, mesh, cap_x=8192, vcap=1 << 16,
-                         progress=progress)
-    half = chk.run(max_depth=depth - 4, checkpoint_dir=ckdir)
-    assert half.ok, half.violation
-    assert list(half.level_sizes) == GOLDEN[: depth - 3], half.level_sizes
+    if resumable and len(resumable) >= depth:
+        # a completed (or deeper) chain would make "resume" a pure replay
+        # — no kill/resume cycle would be exercised and the golden check
+        # would compare the wrong prefix.  Start clean instead.
+        for f in os.listdir(ckdir):
+            os.unlink(os.path.join(ckdir, f))
+        resumable = []
+    if resumable:
+        # an interrupted earlier run left a chain — resuming IT is the
+        # kill/resume cycle; skip phase 1
+        resumed_at = len(resumable)
+        print(f"[mesh] resuming existing chain at depth {resumed_at}",
+              file=sys.stderr, flush=True)
+    else:
+        # phase 1: run to depth-4 short of the target, checkpointing
+        chk = ShardedChecker(cfg, mesh, cap_x=8192, vcap=1 << 16,
+                             progress=progress)
+        half = chk.run(max_depth=depth - 4, checkpoint_dir=ckdir)
+        assert half.ok, half.violation
+        assert list(half.level_sizes) == GOLDEN[: depth - 3], half.level_sizes
+        resumed_at = depth - 4
 
     # phase 2: a FRESH checker resumes from the mdelta log (the kill/
     # resume cycle) and finishes the run
@@ -74,7 +92,7 @@ def main():
         golden_match=list(res.level_sizes) == GOLDEN[: depth + 1],
         seconds=round(dt, 1), devices=8, cap_x_final=chk2.cap_x,
         vcap_final=chk2.vcap, exchange="all_to_all",
-        resumed_at_depth=depth - 4,
+        resumed_at_depth=resumed_at,
     )
     print(json.dumps(out))
     with open("docs/MESH_DEEP.json", "w") as f:

@@ -6,13 +6,13 @@ jax import.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tla_raft_tpu.xla_env import ensure_virtual_cpu_mesh  # noqa: E402
+
+ensure_virtual_cpu_mesh(8)
 
 # The ambient TPU-tunnel sitecustomize pins jax to its platform via
 # jax.config at interpreter start, which overrides the env var — force the

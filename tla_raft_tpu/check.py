@@ -230,7 +230,7 @@ def main(argv=None) -> int:
             )
             out.flush()
 
-        host_store = None
+        host_store = None  # single-device external store (mesh has its own)
         if args.fpstore_dir and not args.mesh:
             from .native import HostFPStore
 

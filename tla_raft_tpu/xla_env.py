@@ -1,0 +1,35 @@
+"""Pre-jax-import environment bootstrap for virtual CPU meshes.
+
+Must run BEFORE jax (or anything that imports it): the ambient TPU-tunnel
+sitecustomize pins the platform via jax.config at interpreter start, which
+overrides JAX_PLATFORMS alone, and XLA_FLAGS are read at backend init.
+This module deliberately does not import jax — callers do, afterwards.
+
+Shared by tests/conftest.py, __graft_entry__.dryrun_multichip and
+scripts/mesh_deep_parity.py so the flag set cannot drift between entry
+points (round-4 advisor finding).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_virtual_cpu_mesh(n_devices: int = 8) -> None:
+    """Point JAX at N virtual CPU devices with sane collective timeouts."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    xla = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla:
+        xla = (
+            xla + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    if "collective_call_terminate" not in xla:
+        # virtual devices timeshare the host CPU; XLA aborts the whole
+        # process when a collective's participant threads miss a 40 s
+        # hard rendezvous window (hit at ~100k-state virtual-mesh levels
+        # on a 1-core host).  Wall-clock guards, not correctness knobs.
+        xla += (
+            " --xla_cpu_collective_call_terminate_timeout_seconds=3600"
+            " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
+        )
+    os.environ["XLA_FLAGS"] = xla
