@@ -4,10 +4,19 @@
 # TPU tunnel is up (bench.py's init retry + watchdog handles flakes, but
 # a dead tunnel wastes ~30 min per step timing out).
 #
-# Usage: scripts/chip_campaign.sh [step...]   (default: all)
+# Usage: scripts/chip_campaign.sh [step...]
+# Default: fix1 fix2 s3 s5 (the scored essentials).  Extra steps —
+# s3big, s7, sweep — are opt-in (each is hours-class on its own).
 set -u
 cd "$(dirname "$0")/.."
 steps=("${@:-fix1 fix2 s3 s5}")
+known=" fix1 fix2 s3 s3big s5 s7 sweep "
+for s in ${steps[@]}; do
+  case "$known" in
+    *" $s "*) ;;
+    *) echo "unknown step: $s (known:$known)" >&2; exit 2 ;;
+  esac
+done
 
 fail=0
 
@@ -47,7 +56,6 @@ for s in ${steps[@]}; do
       run_bench docs/BENCH_S7_r04.json BENCH_SERVERS=7 BENCH_MAX_DEPTH=9 ;;
     sweep) # deep-sweep continuation: level 29+ under host paging
       scripts/run_sweep.sh || fail=1 ;;
-    *) echo "unknown step: $s" >&2; exit 2 ;;
   esac
 done
 exit $fail

@@ -37,16 +37,39 @@ def main():
 
     import glob
 
+    import numpy as np
+
     depth = int(sys.argv[1]) if len(sys.argv) > 1 else 14
     ckdir = sys.argv[2] if len(sys.argv) > 2 else "/tmp/mesh_deep_ck"
     os.makedirs(ckdir, exist_ok=True)
     resumable = sorted(glob.glob(os.path.join(ckdir, "mdelta_*.npz")))
+    # a usable partial chain must (a) leave levels to run — a completed
+    # chain would make "resume" a pure replay exercising no kill/resume
+    # cycle — and (b) match the golden prefix level for level (a chain
+    # left by a run that failed its golden assert must not eat another
+    # hour-class phase 2 before failing again)
+    if resumable and len(resumable) < depth:
+        chain_sizes = [
+            int(np.load(f)["meta"][1]) for f in resumable
+        ]
+        if chain_sizes != GOLDEN[1 : len(chain_sizes) + 1]:
+            print(f"[mesh] existing chain diverges from golden "
+                  f"({chain_sizes} vs {GOLDEN[1:len(chain_sizes)+1]}); "
+                  "starting clean", file=sys.stderr, flush=True)
+            resumable = []
+    elif resumable:
+        resumable = []
     if not resumable:
         for f in os.listdir(ckdir):
             os.unlink(os.path.join(ckdir, f))
 
     cfg = load_raft_config("/root/reference/Raft.cfg")
     mesh = make_mesh(8)
+    # pre-size cap_x for the deepest level: level 14 carries ~20k
+    # candidates per device, and every cap_x-growth retry RECOMPILES
+    # the full 8-device collective program (>1 h each on a 1-core
+    # host -- the round-4 depth-14 attempts died on exactly this)
+    cap_x = 8192 if depth <= 13 else 32768
     t0 = time.monotonic()
     levels = []
 
@@ -56,13 +79,6 @@ def main():
               f"distinct {s['distinct']}, {s['elapsed']:.0f}s",
               file=sys.stderr, flush=True)
 
-    if resumable and len(resumable) >= depth:
-        # a completed (or deeper) chain would make "resume" a pure replay
-        # — no kill/resume cycle would be exercised and the golden check
-        # would compare the wrong prefix.  Start clean instead.
-        for f in os.listdir(ckdir):
-            os.unlink(os.path.join(ckdir, f))
-        resumable = []
     if resumable:
         # an interrupted earlier run left a chain — resuming IT is the
         # kill/resume cycle; skip phase 1
@@ -71,7 +87,7 @@ def main():
               file=sys.stderr, flush=True)
     else:
         # phase 1: run to depth-4 short of the target, checkpointing
-        chk = ShardedChecker(cfg, mesh, cap_x=8192, vcap=1 << 16,
+        chk = ShardedChecker(cfg, mesh, cap_x=cap_x, vcap=1 << 16,
                              progress=progress)
         half = chk.run(max_depth=depth - 4, checkpoint_dir=ckdir)
         assert half.ok, half.violation
@@ -80,7 +96,7 @@ def main():
 
     # phase 2: a FRESH checker resumes from the mdelta log (the kill/
     # resume cycle) and finishes the run
-    chk2 = ShardedChecker(cfg, mesh, cap_x=8192, vcap=1 << 16,
+    chk2 = ShardedChecker(cfg, mesh, cap_x=cap_x, vcap=1 << 16,
                           progress=progress)
     res = chk2.run(max_depth=depth, checkpoint_dir=ckdir,
                    resume_from=ckdir)
