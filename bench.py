@@ -50,8 +50,10 @@ import time
 # config's fixpoint (~10^9 states, BASELINE.md) has not been reached by
 # any engine yet and stays unpinned.
 GOLDEN_FULL = {
-    (3, 1, 2, 1): (180_582, 747_500, 35),
-    (3, 1, 2, 2): (223_437, 936_729, 36),
+    (3, 1, 2, 1): (180_582, 747_500, 35),  # cpubase ≡ oracle (exact)
+    (3, 1, 2, 2): (223_437, 936_729, 36),  # cpubase ≡ oracle (exact)
+    # cpubase only — the 4.85M-state oracle run exceeded round 4's CPU
+    # budget; cross-check it (or a chip run) before relying on this row
     (3, 2, 2, 0): (4_850_261, 26_087_894, 45),
 }
 
@@ -106,7 +108,12 @@ def _init_jax_or_reexec():
             f"backend init hung > {INIT_TIMEOUT_S}s (tunnel unresponsive)"
         )
 
-    INIT_TIMEOUT_S = int(os.environ.get("BENCH_INIT_TIMEOUT_S", "300"))
+    # first attempt gets the full window (cold tunnel init is slow but
+    # legitimate); retries get a shorter one so a hard-down tunnel still
+    # reaches the parseable ok:false line in ~13 min, not ~27
+    INIT_TIMEOUT_S = int(
+        os.environ.get("BENCH_INIT_TIMEOUT_S", "300" if attempt == 0 else "120")
+    )
     old_handler = signal.signal(signal.SIGALRM, _on_alarm)
     signal.alarm(INIT_TIMEOUT_S)
     try:

@@ -2,7 +2,7 @@
 # Round-4 on-chip measurement campaign, in priority order.  Each step is
 # independently resumable; artifacts land in docs/.  Run only when the
 # TPU tunnel is up (bench.py's init retry + watchdog handles flakes, but
-# a dead tunnel wastes ~30 min per step timing out).
+# a dead tunnel still wastes ~14 min per step timing out).
 #
 # Usage: scripts/chip_campaign.sh [step...]
 # Default: fix1 fix2 s3 s5 (the scored essentials).  Extra steps —
