@@ -1,17 +1,19 @@
 #!/bin/bash
 # Round-4 on-chip measurement campaign, in priority order.  Each step is
 # independently resumable; artifacts land in docs/.  Run only when the
-# TPU tunnel is up (bench.py's init retry + watchdog handles flakes, but
-# a dead tunnel still wastes ~14 min per step timing out).
+# TPU tunnel is up (bench.py's init retry + watchdog handles flakes; a
+# dead tunnel burns ~7 min per step before the ok:false line — probe
+# first with scripts/probe_tunnel.py).
 #
 # Usage: scripts/chip_campaign.sh [step...]
 # Default: fix1 fix2 s3 s5 (the scored essentials).  Extra steps —
 # s3big, s7, sweep — are opt-in (each is hours-class on its own).
 set -u
 cd "$(dirname "$0")/.."
-steps=("${@:-fix1 fix2 s3 s5}")
+steps=("$@")
+[ $# -eq 0 ] && steps=(fix1 fix2 s3 s5)
 known=" fix1 fix2 s3 s3big s5 s7 sweep "
-for s in ${steps[@]}; do
+for s in "${steps[@]}"; do
   case "$known" in
     *" $s "*) ;;
     *) echo "unknown step: $s (known:$known)" >&2; exit 2 ;;
@@ -35,7 +37,7 @@ run_bench() {  # run_bench <outfile> [ENV=VAL ...]
   return $rc
 }
 
-for s in ${steps[@]}; do
+for s in "${steps[@]}"; do
   case "$s" in
     fix1)  # completed fixpoint, pinned golden total (GOLDEN_FULL gate)
       run_bench docs/BENCH_FIX_V1MR1_r04.json \

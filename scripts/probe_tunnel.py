@@ -1,0 +1,35 @@
+"""Bounded-timeout tunnel probe: exit 0 if the axon TPU backend comes up
+and runs a trivial computation, exit 1 on hang/failure.
+
+Usage: python scripts/probe_tunnel.py [timeout_s]
+"""
+import os, signal, sys
+
+timeout = int(sys.argv[1]) if len(sys.argv) > 1 else 120
+
+def _alarm(sig, frm):
+    print(f"PROBE: tunnel DOWN (hung > {timeout}s)", flush=True)
+    os._exit(1)
+
+signal.signal(signal.SIGALRM, _alarm)
+signal.alarm(timeout)
+try:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from tla_raft_tpu.platform import setup_jax
+
+    jax = setup_jax()
+    import jax.numpy as jnp
+    devs = jax.devices()
+    # a silent CPU fallback is NOT a live tunnel — gating an hours-class
+    # chip campaign on it would launch against a dead backend
+    assert devs[0].platform != "cpu", f"CPU fallback, not a TPU: {devs}"
+    x = jnp.ones((8, 8))
+    y = (x @ x).sum()
+    v = float(jax.device_get(y))
+    signal.alarm(0)
+    print(f"PROBE: tunnel UP devices={devs} check={v}", flush=True)
+    sys.exit(0)
+except Exception as e:
+    signal.alarm(0)
+    print(f"PROBE: tunnel DOWN ({type(e).__name__}: {e})", flush=True)
+    sys.exit(1)

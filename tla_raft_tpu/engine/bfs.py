@@ -464,7 +464,11 @@ class JaxChecker:
         # one level's parent+child segments would exceed it, sealed child
         # segments demote to host RAM and page back in on demand — the
         # tier that breaks the single-frontier-in-HBM wall at level 29 of
-        # the reference sweep (BASELINE.md)
+        # the reference sweep (BASELINE.md).  The budget prices LIVE
+        # buffers only; the expand walk's one-entry parent page cache and
+        # the paged-parent fetch buffer are transient extras on top, so
+        # set the budget with a few segments of headroom below physical
+        # HBM (run_sweep.sh's 11 GB of 16 GB leaves ~45 segments' worth)
         self.dev_budget = int(float(os.environ.get("TLA_RAFT_DEV_BYTES", "0")))
         self.paged_out = 0  # sealed child segments demoted to host RAM
         if host_store is not None and chunk > SEG_ROWS:
