@@ -55,15 +55,15 @@ import time
 GOLDEN_FULL = {
     (3, 1, 2, 1): (180_582, 747_500, 35),  # cpubase ≡ oracle (exact)
     (3, 1, 2, 2): (223_437, 936_729, 36),  # cpubase ≡ oracle (exact)
-    # cpubase only — the 4.85M-state oracle run exceeded round 4's CPU
-    # budget; cross-check it (or a chip run) before relying on this row
+    # cpubase ≡ oracle (exact, round 5: 2.9-h oracle fixpoint run,
+    # docs/ORACLE_FIX_V2ME2MR0.json — closes VERDICT r4 weak #3)
     (3, 2, 2, 0): (4_850_261, 26_087_894, 45),
 }
 # Rows confirmed by only ONE engine are ADVISORY (ADVICE r4 #1): a
 # mismatch is warned and recorded but does not gate parity, so a bug in
 # the single source cannot reject a correct chip run.  Remove a key here
 # the moment a second independent engine confirms its totals.
-GOLDEN_FULL_SINGLE_SOURCE = {(3, 2, 2, 0)}
+GOLDEN_FULL_SINGLE_SOURCE: set = set()
 
 # Per-level new-state counts of the deepest verified record (BASELINE.md
 # "golden counts": levels 0-15 double-verified oracle+engine, 16+ device-

@@ -12,7 +12,7 @@ set -u
 cd "$(dirname "$0")/.."
 steps=("$@")
 [ $# -eq 0 ] && steps=(fix1 fix2 s3 s5)
-known=" fix1 fix2 s3 s3big s5 s7 sweep "
+known=" fix1 fix2 s3 s3big s5 s7 s7base sweep "
 for s in "${steps[@]}"; do
   case "$known" in
     *" $s "*) ;;
@@ -40,22 +40,27 @@ run_bench() {  # run_bench <outfile> [ENV=VAL ...]
 for s in "${steps[@]}"; do
   case "$s" in
     fix1)  # completed fixpoint, pinned golden total (GOLDEN_FULL gate)
-      run_bench docs/BENCH_FIX_V1MR1_r04.json \
+      run_bench docs/BENCH_FIX_V1MR1_r05.json \
         BENCH_MAX_DEPTH=0 BENCH_VALS=1 BENCH_MAX_ELECTION=2 \
         BENCH_MAX_RESTART=1 BENCH_NATIVE_DEPTH=35 ;;
     fix2)
-      run_bench docs/BENCH_FIX_V1MR2_r04.json \
+      run_bench docs/BENCH_FIX_V1MR2_r05.json \
         BENCH_MAX_DEPTH=0 BENCH_VALS=1 BENCH_MAX_ELECTION=2 \
         BENCH_MAX_RESTART=2 BENCH_NATIVE_DEPTH=36 ;;
     s3)    # the headline: reference config depth-19, warm spans
-      run_bench docs/BENCH_S3_r04.json ;;
+      run_bench docs/BENCH_S3_r05.json ;;
     s3big) # bigger chunk variant
-      run_bench docs/BENCH_S3_c16k_r04.json BENCH_CHUNK=16384 ;;
+      run_bench docs/BENCH_S3_c16k_r05.json BENCH_CHUNK=16384 ;;
     s5)    # scale config 3 (warm steady-state — run s5 twice; the
            # second run reads the persistent compile cache)
-      run_bench docs/BENCH_S5_r04.json BENCH_SERVERS=5 BENCH_MAX_DEPTH=16 ;;
-    s7)    # scale config 5 (depth 9 — deeper than r2's 8 for a warmer rate)
-      run_bench docs/BENCH_S7_r04.json BENCH_SERVERS=7 BENCH_MAX_DEPTH=9 ;;
+      run_bench docs/BENCH_S5_r05.json BENCH_SERVERS=5 BENCH_MAX_DEPTH=16 ;;
+    s7)    # scale config 5 (depth 9 — deeper than r2's 8 for a warmer
+           # rate), with orbit pruning: color-discrete states skip the
+           # P=5040 fold (counts unchanged — the parity gate still holds)
+      run_bench docs/BENCH_S7_r05.json BENCH_SERVERS=7 BENCH_MAX_DEPTH=9 \
+        TLA_RAFT_ORBIT=1 ;;
+    s7base) # same without orbit pruning (A/B the fold cost)
+      run_bench docs/BENCH_S7_BASE_r05.json BENCH_SERVERS=7 BENCH_MAX_DEPTH=9 ;;
     sweep) # deep-sweep continuation: level 29+ under host paging
       scripts/run_sweep.sh || fail=1 ;;
   esac

@@ -65,11 +65,16 @@ def main():
 
     cfg = load_raft_config("/root/reference/Raft.cfg")
     mesh = make_mesh(8)
-    # pre-size cap_x for the deepest level: level 14 carries ~20k
-    # candidates per device, and every cap_x-growth retry RECOMPILES
-    # the full 8-device collective program (>1 h each on a 1-core
-    # host -- the round-4 depth-14 attempts died on exactly this)
-    cap_x = 8192 if depth <= 13 else 32768
+    # capacity sizing is now the ENGINE's job (run(presize=True) default,
+    # engine/forecast.py): it forecasts cap_x/vcap for the whole run at
+    # the first trustworthy level and resizes BEFORE compiling, so
+    # growth-triggered recompiles of the 8-device collective program
+    # (>1 h each on this 1-core host — the round-4 depth-14 killer)
+    # never fire.  The script only supplies the measured candidate-peak
+    # CEILING (level 14 carries ~20k candidates/device) so an early
+    # forecast overshoot can't double the one big compile's shape.
+    cap_x = 8192
+    cap_x_max = 8192 if depth <= 13 else 32768
     t0 = time.monotonic()
     levels = []
 
@@ -88,7 +93,7 @@ def main():
     else:
         # phase 1: run to depth-4 short of the target, checkpointing
         chk = ShardedChecker(cfg, mesh, cap_x=cap_x, vcap=1 << 16,
-                             progress=progress)
+                             cap_x_max=cap_x_max, progress=progress)
         half = chk.run(max_depth=depth - 4, checkpoint_dir=ckdir)
         assert half.ok, half.violation
         assert list(half.level_sizes) == GOLDEN[: depth - 3], half.level_sizes
@@ -97,7 +102,7 @@ def main():
     # phase 2: a FRESH checker resumes from the mdelta log (the kill/
     # resume cycle) and finishes the run
     chk2 = ShardedChecker(cfg, mesh, cap_x=cap_x, vcap=1 << 16,
-                          progress=progress)
+                          cap_x_max=cap_x_max, progress=progress)
     res = chk2.run(max_depth=depth, checkpoint_dir=ckdir,
                    resume_from=ckdir)
     dt = time.monotonic() - t0
@@ -109,6 +114,9 @@ def main():
         seconds=round(dt, 1), devices=8, cap_x_final=chk2.cap_x,
         vcap_final=chk2.vcap, exchange="all_to_all",
         resumed_at_depth=resumed_at,
+        # reactive growth events = presize forecast misses; the whole
+        # point of predictive sizing is that this stays 0
+        reactive_grows=chk2.reactive_grows,
     )
     print(json.dumps(out))
     with open("docs/MESH_DEEP.json", "w") as f:

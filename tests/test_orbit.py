@@ -1,0 +1,220 @@
+"""Orbit pruning: canonical-relabel fingerprints (VERDICT r4 #6).
+
+The P-folded min-fingerprint (ops/fingerprint.py) costs O(P) per state;
+for color-discrete states the orbit path hashes ONE canonical relabeling
+instead.  These tests pin the three load-bearing claims:
+
+1. the Lehmer rank maps the color-sort permutation to its exact index in
+   ``server_perms()`` (itertools lexicographic order);
+2. where discrete, the orbit fingerprint is bit-identical to the folded
+   table path's column at that permutation (same coefficients, same
+   plane linearization);
+3. the fingerprint is orbit-INVARIANT: every server relabeling of a
+   state produces the same (fp_view, fp_full, discrete) triple;
+4. end to end, an engine run under TLA_RAFT_ORBIT=1 reproduces the
+   oracle's distinct/generated/depth/level-size/coverage counts exactly
+   (the definition change moves fingerprint VALUES, never counts).
+"""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.models.raft import RaftState, init_batch
+from tla_raft_tpu.oracle import OracleChecker
+from tla_raft_tpu.ops.fingerprint import Fingerprinter
+from tla_raft_tpu.ops.successor import get_kernel
+
+CFG = RaftConfig(n_servers=3, n_vals=1, max_election=1, max_restart=0)
+
+
+def _random_states(cfg, n, seed=0):
+    """Structurally valid (not necessarily reachable) random states."""
+    S, L, V = cfg.S, cfg.L, cfg.V
+    uni = get_kernel(cfg).uni
+    r = np.random.default_rng(seed)
+    u8 = lambda *shape, lo=0, hi=3: r.integers(lo, hi + 1, size=shape
+                                               ).astype(np.uint8)
+    bits = (r.random((n, uni.M)) < 0.1).astype(np.uint8)
+    msgs = np.zeros((n, uni.n_words), np.uint32)
+    for w in range(uni.n_words):
+        for b in range(min(32, uni.M - 32 * w)):
+            msgs[:, w] |= bits[:, 32 * w + b].astype(np.uint32) << np.uint32(b)
+    return RaftState(
+        voted_for=jnp.asarray(u8(n, S, hi=S)),
+        current_term=jnp.asarray(u8(n, S)),
+        role=jnp.asarray(u8(n, S, hi=2)),
+        log_term=jnp.asarray(u8(n, S, L)),
+        log_val=jnp.asarray(u8(n, S, L, hi=V)),
+        log_len=jnp.asarray(u8(n, S, lo=1, hi=L)),
+        match_index=jnp.asarray(u8(n, S, S, lo=1, hi=L)),
+        next_index=jnp.asarray(u8(n, S, S, lo=2, hi=L + 1)),
+        commit_index=jnp.asarray(u8(n, S, lo=1, hi=L)),
+        election_count=jnp.asarray(u8(n, hi=1)),
+        restart_count=jnp.asarray(u8(n, hi=1)),
+        pending=jnp.asarray(u8(n, S, S, hi=1)),
+        val_sent=jnp.asarray(u8(n, V, hi=2)),
+        msgs=jnp.asarray(msgs),
+    ), bits
+
+
+def _permute_state(cfg, st, bits, p):
+    """Apply server relabeling p (1-based images) host-side: positions of
+    every per-server structure move, and server-VALUED content
+    (votedFor, message src/dst) is remapped through p."""
+    S = cfg.S
+    uni = get_kernel(cfg).uni
+    inv = np.empty(S, np.int64)
+    for s0 in range(S):
+        inv[p[s0] - 1] = s0
+    g = lambda x: np.asarray(x)
+    vf = g(st.voted_for)
+    wmap = np.concatenate([[0], np.asarray(p, np.uint8)])
+    pi = cfg.server_perms().index(tuple(p))
+    pt = uni.perm_table[pi]
+    bits_p = np.zeros_like(bits)
+    bits_p[:, pt] = bits
+    n = bits.shape[0]
+    msgs = np.zeros((n, uni.n_words), np.uint32)
+    for w in range(uni.n_words):
+        for b in range(min(32, uni.M - 32 * w)):
+            msgs[:, w] |= bits_p[:, 32 * w + b].astype(np.uint32) << np.uint32(b)
+    return RaftState(
+        voted_for=jnp.asarray(wmap[vf[:, inv]]),
+        current_term=jnp.asarray(g(st.current_term)[:, inv]),
+        role=jnp.asarray(g(st.role)[:, inv]),
+        log_term=jnp.asarray(g(st.log_term)[:, inv]),
+        log_val=jnp.asarray(g(st.log_val)[:, inv]),
+        log_len=jnp.asarray(g(st.log_len)[:, inv]),
+        match_index=jnp.asarray(g(st.match_index)[:, inv][:, :, inv]),
+        next_index=jnp.asarray(g(st.next_index)[:, inv][:, :, inv]),
+        commit_index=jnp.asarray(g(st.commit_index)[:, inv]),
+        election_count=st.election_count,
+        restart_count=st.restart_count,
+        pending=jnp.asarray(g(st.pending)[:, inv][:, :, inv]),
+        val_sent=st.val_sent,
+        msgs=jnp.asarray(msgs),
+    ), bits_p
+
+
+@pytest.mark.parametrize("S", [3, 4])
+def test_lehmer_rank_matches_perm_order(S):
+    cfg = RaftConfig(n_servers=S, n_vals=1, max_election=1, max_restart=0)
+    fpr = Fingerprinter(cfg)
+    perms = cfg.server_perms()
+    # colors c[s] = p[s]-1 make the color-sort permutation equal p itself
+    colors = jnp.asarray(
+        np.array(perms, np.uint32) - 1
+    )
+    rank, disc = fpr._orbit_rank(colors)
+    assert bool(disc.all())
+    assert list(np.asarray(rank)) == list(range(len(perms)))
+
+
+def test_orbit_matches_fold_column():
+    fpr = Fingerprinter(CFG)
+    st, _bits = _random_states(CFG, 256)
+    fv, ff, disc = fpr.state_fingerprints_orbit(st)
+    assert bool(jnp.asarray(disc).any()), "no discrete rows in sample"
+    # the standard per-permutation hash table
+    h = fpr.feat_hash(fpr.spec.features(st)) + fpr.msg_hash(st.msgs)
+    h64 = h.astype(jnp.uint64)
+    view_all = (h64[..., 0] << jnp.uint64(32)) | h64[..., 1]  # [N, P]
+    full_all = (h64[..., 2] << jnp.uint64(32)) | h64[..., 3]
+    colors = fpr._orbit_colors(st, fpr._orbit_pairh(fpr.unpack_bits(st.msgs)))
+    rank, disc2 = fpr._orbit_rank(colors)
+    assert bool((disc == disc2).all())
+    sel = np.asarray(disc)
+    want_v = np.take_along_axis(
+        np.asarray(view_all), np.asarray(rank)[:, None], axis=1
+    )[:, 0]
+    want_f = np.take_along_axis(
+        np.asarray(full_all), np.asarray(rank)[:, None], axis=1
+    )[:, 0]
+    np.testing.assert_array_equal(np.asarray(fv)[sel], want_v[sel])
+    np.testing.assert_array_equal(np.asarray(ff)[sel], want_f[sel])
+
+
+def test_orbit_invariance_under_relabeling():
+    fpr = Fingerprinter(CFG)
+    st, bits = _random_states(CFG, 128, seed=7)
+    fv0, ff0, d0 = (np.asarray(x) for x in fpr.state_fingerprints_orbit(st))
+    for p in itertools.permutations(range(1, CFG.S + 1)):
+        stp, _ = _permute_state(CFG, st, bits, p)
+        fv, ff, d = (np.asarray(x) for x in fpr.state_fingerprints_orbit(stp))
+        np.testing.assert_array_equal(d, d0)
+        np.testing.assert_array_equal(fv[d0], fv0[d0])
+        np.testing.assert_array_equal(ff[d0], ff0[d0])
+
+
+def test_init_state_is_symmetric_not_discrete():
+    fpr = Fingerprinter(CFG)
+    st = init_batch(CFG, 1)
+    _fv, _ff, disc = fpr.state_fingerprints_orbit(st)
+    assert not bool(np.asarray(disc)[0])
+
+
+@pytest.mark.parametrize(
+    "cfg",
+    [
+        RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1),
+        RaftConfig(n_servers=3, n_vals=1, max_election=1, max_restart=0),
+    ],
+    ids=["s2", "s3"],
+)
+def test_engine_orbit_parity_vs_oracle(cfg, monkeypatch):
+    monkeypatch.setenv("TLA_RAFT_ORBIT", "1")
+    from tla_raft_tpu.engine import JaxChecker
+
+    want = OracleChecker(cfg).run()
+    got = JaxChecker(cfg, chunk=64).run()
+    assert got.ok == want.ok
+    assert got.distinct == want.distinct
+    assert got.generated == want.generated
+    assert got.depth == want.depth
+    assert got.level_sizes == want.level_sizes
+    assert got.action_counts == want.action_counts
+
+
+def test_orbit_checkpoint_definition_guard(tmp_path, monkeypatch):
+    """A checkpoint written under one fingerprint definition must refuse
+    to resume under the other (the values are incompatible; mixing them
+    silently re-admits visited states)."""
+    cfg = RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1)
+    monkeypatch.setenv("TLA_RAFT_ORBIT", "1")
+    from tla_raft_tpu.engine import JaxChecker
+
+    ck = str(tmp_path / "orbit_run")
+    JaxChecker(cfg, chunk=64).run(max_depth=3, checkpoint_dir=ck)
+    monkeypatch.setenv("TLA_RAFT_ORBIT", "0")
+    with pytest.raises(ValueError, match="fingerprint-definition mismatch"):
+        JaxChecker(cfg, chunk=64).run(resume_from=ck)
+
+
+@pytest.mark.slow
+def test_orbit_matches_fold_column_s7():
+    """The canonical-column identity must hold against the PAIR-BLOCK
+    factored fold too (S=7 auto-selects it; S=3 above uses the
+    monolithic table)."""
+    cfg = RaftConfig(n_servers=7, n_vals=1, max_election=1, max_restart=0)
+    fpr = Fingerprinter(cfg)
+    st, _bits = _random_states(cfg, 16, seed=3)
+    fv, ff, disc = fpr.state_fingerprints_orbit(st)
+    sel = np.asarray(disc)
+    assert sel.any(), "no discrete rows at S=7 (expected nearly all)"
+    h = fpr.feat_hash(fpr.spec.features(st)) + fpr.msg_hash(st.msgs)
+    h64 = np.asarray(h.astype(jnp.uint64))
+    view_all = (h64[..., 0] << np.uint64(32)) | h64[..., 1]
+    full_all = (h64[..., 2] << np.uint64(32)) | h64[..., 3]
+    colors = fpr._orbit_colors(st, fpr._orbit_pairh(fpr.unpack_bits(st.msgs)))
+    rank, _ = fpr._orbit_rank(colors)
+    rk = np.asarray(rank)[:, None]
+    np.testing.assert_array_equal(
+        np.asarray(fv)[sel], np.take_along_axis(view_all, rk, 1)[:, 0][sel]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ff)[sel], np.take_along_axis(full_all, rk, 1)[:, 0][sel]
+    )

@@ -129,3 +129,18 @@ def test_engine_parity_s7(cfg7):
     assert e.level_sizes == o.level_sizes
     assert e.generated == o.generated
     assert e.distinct == o.distinct
+
+
+def test_engine_parity_s7_orbit(cfg7, monkeypatch):
+    """BFS parity with orbit pruning engaged at S=7 (P=5040): the
+    canonical-relabel fast path plus the compacted fold fallback must
+    reproduce the oracle's counts exactly (tests/test_orbit.py proves
+    the hash identities; this proves the engine composition at the
+    scale the feature exists for)."""
+    monkeypatch.setenv("TLA_RAFT_ORBIT", "1")
+    o = OracleChecker(cfg7).run(max_depth=4)
+    e = JaxChecker(cfg7, chunk=64).run(max_depth=4)
+    assert o.ok and e.ok
+    assert e.level_sizes == o.level_sizes
+    assert e.generated == o.generated
+    assert e.distinct == o.distinct

@@ -67,6 +67,7 @@ import numpy as np
 from ..config import RaftConfig
 from ..models.raft import RaftState, init_batch, to_oracle
 from ..ops.successor import SuccessorKernel, get_kernel
+from .forecast import MIN_LEVELS as PRESIZE_MIN_LEVELS, pow2ceil as _pow2
 from .invariants import resolve_invariant_kernel
 
 U64 = jnp.uint64
@@ -139,9 +140,8 @@ def _seg_rows(seg) -> int:
     return seg.rows if isinstance(seg, _HostSeg) else seg.voted_for.shape[0]
 
 
-def _pow2(n: int) -> int:
-    return 1 << max(0, (n - 1)).bit_length()
-
+# _pow2 is forecast.pow2ceil (imported above) — one next-power-of-two
+# helper shared by the engines and the capacity forecaster.
 
 # Uniform segment size for external-store frontiers (rows).  ONE fixed
 # buffer shape per field across every deep level serves two masters:
@@ -497,6 +497,30 @@ class JaxChecker:
         # per-chunk path (some monkeypatch _expand_chunk); lower this to
         # exercise spans at test scale.
         self.span_min_chunk = 2048
+        # predictive capacity pre-sizing (VERDICT r4 #7): forecast-floor
+        # the frontier/visited pow2 ladders from the measured growth
+        # model (engine/forecast.py) so a deep run compiles each program
+        # ONCE instead of once per magnitude — on the tunneled backend
+        # every extra magnitude is a minutes-class remote compile
+        # (docs/PERF.md; the S=5 bench burned most of its 2,075 s wall on
+        # 7 magnitude compiles).  Floors only ratchet up (shrinking would
+        # mint new shapes).  Default: on for tunneled backends, off
+        # locally where compiles are cheap and tests drive tiny shapes.
+        env_ps = os.environ.get("TLA_RAFT_PRESIZE")
+        self.presize = bool(int(env_ps)) if env_ps else _is_tunneled()
+        self._presize_fcap = 0  # frontier-capacity floor (pow2, >= chunk)
+        self._presize_vcap = 0  # visited-store trim floor (pow4)
+        self._presize_merge = 0  # store merge-input width floor (pow2)
+        # orbit pruning (VERDICT r4 #6, ops/fingerprint.py "orbit
+        # pruning"): canonical-relabel fingerprints for color-discrete
+        # candidates; only the (few) tied states pay the P-fold, on a
+        # cap_x/4 compacted budget.  Changes fingerprint VALUES (not
+        # counts), so it must stay consistent across a run and its
+        # checkpoints — opt-in via TLA_RAFT_ORBIT=1, late canon only.
+        env_orb = os.environ.get("TLA_RAFT_ORBIT")
+        self.orbit = bool(int(env_orb)) if env_orb else False
+        if self.orbit and canon != "late":
+            raise ValueError("TLA_RAFT_ORBIT requires canon='late'")
 
     # -- sparse <-> dense message-set conversion ---------------------------
 
@@ -626,7 +650,11 @@ class JaxChecker:
             slots = cp_raw % K
             parents = jax.tree.map(lambda x: x[lidx], part)
             children = self.kern.materialize(parents, slots)
-            fv, ff, _msum = self.fpr.state_fingerprints(children)
+            if self.orbit:
+                fv, ff, nd_ovf = self._orbit_chunk_fps(children, lane)
+                overflow = overflow | nd_ovf
+            else:
+                fv, ff, _msum = self.fpr.state_fingerprints(children)
             cv = jnp.where(lane, fv.astype(U64), SENT)
             cf = jnp.where(lane, ff.astype(U64), SENT)
             cp = jnp.where(lane, cp_raw, -1)
@@ -635,6 +663,40 @@ class JaxChecker:
             fpf = jnp.where(valid, exp.fp_full, SENT).ravel()
             cv, cf, cp, overflow = _chunk_compact(fpv, fpf, payload, self.cap_x)
         return cv, cf, cp, mult_slots, abort_at, overflow
+
+    def _orbit_chunk_fps(self, children, lane):
+        """Orbit-pruned fingerprints for one chunk's compacted candidates.
+
+        Color-discrete rows (the vast majority on non-trivial levels) get
+        the canonical-relabel hash; tied rows are compacted into a
+        cap_x/4 sub-budget and run the exact min-over-P fold there.  If
+        more than cap_x/4 rows are tied (early symmetric levels) the
+        chunk reports overflow — the engine's existing redo then doubles
+        cap_x, and with it this sub-budget, until the level fits.
+        Returns (fp_view, fp_full, overflow)."""
+        fv, ff, disc = self.fpr.state_fingerprints_orbit(children)
+        need = lane & ~disc
+        cap_nd = max(256, self.cap_x // 4)
+        comp = jnp.argsort(~need, stable=True)[:cap_nd]
+        sub = jax.tree.map(lambda x: x[comp], children)
+        sv, sf, _ = self.fpr.state_fingerprints(sub)
+        take = need[comp]
+        fv = fv.at[comp].set(jnp.where(take, sv, fv[comp]))
+        ff = ff.at[comp].set(jnp.where(take, sf, ff[comp]))
+        return fv, ff, need.sum() > cap_nd
+
+    def _fp_states(self, st):
+        """(fp_view, fp_full) for a small batch, honoring the orbit flag.
+
+        Root/trace/aux paths: computes both routes and selects — these
+        batches are tiny, and the store must hold ONE consistent
+        fingerprint definition per run."""
+        if not self.orbit:
+            fv, ff, _ = self.fpr.state_fingerprints(st)
+            return fv, ff
+        ov, of_, disc = self.fpr.state_fingerprints_orbit(st)
+        sv, sf, _ = self.fpr.state_fingerprints(st)
+        return jnp.where(disc, ov, sv), jnp.where(disc, of_, sf)
 
     def _expand_span_impl(self, frontier, slice_base, global_base, n_f):
         """G chunks in ONE program via lax.scan.
@@ -758,7 +820,11 @@ class JaxChecker:
             slot=slot_np.astype(slot_dt),
             fps=fps_np.astype(np.uint64),
             mult=level_mult.astype(np.int64),
-            meta=np.asarray([depth, n_new], np.int64),
+            # meta[2] (fp definition: 0 = min-over-P fold, 1 = orbit
+            # canonical-relabel) guards resume: the two definitions
+            # produce different fingerprint VALUES and must never mix in
+            # one visited store.  Old two-element logs read as 0.
+            meta=np.asarray([depth, n_new, int(self.orbit)], np.int64),
         )
         os.replace(tmp, os.path.join(ckdir, f"delta_{depth:04d}.npz"))
 
@@ -798,7 +864,44 @@ class JaxChecker:
         c = _cap_steps(n)
         if c % self.chunk:
             c = _pow2(n)
-        return max(c, self.chunk)
+        c = max(c, self.chunk)
+        if self._presize_fcap > c:
+            # forecast floor: pow2 and >= chunk, so still a chunk multiple
+            c = self._presize_fcap
+        return c
+
+    def _update_presize(self, level_sizes, distinct, max_depth, frontier):
+        """Ratchet the forecast capacity floors (see __init__).
+
+        Called once per level; floors only grow.  Frontier bytes are
+        clamped (TLA_RAFT_PRESIZE_BYTES, default 4 GB) so a noisy early
+        forecast cannot reserve more HBM than the run could use."""
+        from .forecast import PRESIZE_HORIZON, forecast_new_states
+
+        fut = forecast_new_states(level_sizes, max_depth)[:PRESIZE_HORIZON]
+        if not fut:
+            return
+        peak = max(fut)
+        budget = int(float(
+            os.environ.get("TLA_RAFT_PRESIZE_BYTES", "4e9")
+        ))
+        want_f = max(_pow2(int(peak * 1.25) + 1), self.chunk)
+        if not isinstance(frontier, list):
+            row_b = sum(
+                int(np.prod(x.shape[1:])) * x.dtype.itemsize
+                for x in jax.tree.leaves(frontier)
+            )
+            while want_f > self.chunk and want_f * row_b > budget:
+                want_f //= 2
+        self._presize_fcap = max(self._presize_fcap, want_f)
+        self._presize_vcap = max(
+            self._presize_vcap,
+            min(_cap4(distinct + sum(fut) + 1), _cap4(budget // 8)),
+        )
+        self._presize_merge = max(
+            self._presize_merge,
+            min(_pow2(int(peak * 1.05) + 1), _pow2(budget // 16)),
+        )
 
     def _materialize_segs(self, segs, pay_np, new_payload, n_new):
         """Segment-streamed materialize for the external-store path.
@@ -1138,6 +1241,7 @@ class JaxChecker:
             ck = self._load_checkpoint(
                 base_path, device_visited=self.host_store is None
             )
+            self._check_fp_def(ck["fp_def"], base_path)
             frontier, n_f = ck["frontier"], ck["n_f"]
             visited_base = ck["visited"]
             if self.host_store is not None:
@@ -1176,7 +1280,7 @@ class JaxChecker:
             depth = ck["depth"]
         else:
             st0 = init_batch(cfg, 1)
-            fv0, _ff0, _ms = self.fpr.state_fingerprints(st0)
+            fv0, _ff0 = self._fp_states(st0)
             frontier, _ovf = jax.jit(self._deflate)(st0)
             frontier = jax.tree.map(
                 lambda x: _pad_axis0(x, self.chunk), frontier
@@ -1196,7 +1300,18 @@ class JaxChecker:
             depth = 0
         for f in files:
             z = np.load(f)
-            d, n_new = (int(x) for x in z["meta"])
+            meta = [int(x) for x in z["meta"]]
+            d, n_new = meta[0], meta[1]
+            fp_def = meta[2] if len(meta) > 2 else 0
+            if fp_def != int(self.orbit):
+                raise ValueError(
+                    f"fingerprint-definition mismatch: delta log {f} was "
+                    f"written with {'orbit' if fp_def else 'min-over-P'} "
+                    f"fingerprints but this run uses "
+                    f"{'orbit' if self.orbit else 'min-over-P'} "
+                    "(TLA_RAFT_ORBIT) — the two cannot share a visited "
+                    "store; resume with the matching setting"
+                )
             if d != depth + 1:
                 raise ValueError(
                     f"delta log gap: expected level {depth + 1}, found "
@@ -1261,6 +1376,7 @@ class JaxChecker:
             visited=np.asarray(visited),
             mult_per_slot=mult_per_slot,
             meta=np.asarray([n_f, distinct, generated, depth], np.int64),
+            fp_def=np.asarray([int(self.orbit)], np.int64),
             level_sizes=np.asarray(level_sizes, np.int64),
             n_trace=np.asarray([len(trace_levels)], np.int64),
             **arrs,
@@ -1271,6 +1387,17 @@ class JaxChecker:
         save = np.savez_compressed if total < (256 << 20) else np.savez
         save(tmp, **payload)
         os.replace(tmp, path)
+
+    def _check_fp_def(self, fp_def: int, path: str) -> None:
+        """Refuse to mix fingerprint definitions in one visited store."""
+        if fp_def != int(self.orbit):
+            raise ValueError(
+                f"fingerprint-definition mismatch: {path} was written "
+                f"with {'orbit' if fp_def else 'min-over-P'} fingerprints "
+                f"but this run uses "
+                f"{'orbit' if self.orbit else 'min-over-P'} "
+                "(TLA_RAFT_ORBIT) — resume with the matching setting"
+            )
 
     def _seed_host_store(self, visited):
         """Insert a visited array's real (non-SENT) fps into the store.
@@ -1303,6 +1430,7 @@ class JaxChecker:
             (z[f"trace_p{i}"], z[f"trace_s{i}"]) for i in range(int(z["n_trace"][0]))
         ]
         return dict(
+            fp_def=int(z["fp_def"][0]) if "fp_def" in z.files else 0,
             frontier=frontier,
             mult_per_slot=np.asarray(z["mult_per_slot"]),
             # host-store resumes read the (potentially multi-GB) visited
@@ -1645,8 +1773,12 @@ class JaxChecker:
         tmp = os.path.join(ckdir, f".tmp_partial_{level:04d}_{gi:05d}.npz")
         np.savez(
             tmp, hv=hv, hf=hf, hp=hp, mult=mult,
+            # meta[7]: fingerprint definition (0 = min-over-P, 1 = orbit)
+            # — a partial's hv/hf are raw fingerprints and must never be
+            # replayed into a run using the other definition
             meta=np.asarray(
-                [level, gi, self.chunk, self.cap_x, self.G, self.K, n_f],
+                [level, gi, self.chunk, self.cap_x, self.G, self.K, n_f,
+                 int(self.orbit)],
                 np.int64,
             ),
         )
@@ -1671,8 +1803,11 @@ class JaxChecker:
                 # independent (its chunks passed the overflow check before
                 # the save), so a cap_x-growth redo of the level keeps
                 # every completed group instead of re-expanding it
-                want = (level, meta[1], self.chunk, self.G, self.K, n_f)
-                got = (meta[0], meta[1], meta[2], meta[4], meta[5], meta[6])
+                fp_def = meta[7] if len(meta) > 7 else 0
+                want = (level, meta[1], self.chunk, self.G, self.K, n_f,
+                        int(self.orbit))
+                got = (meta[0], meta[1], meta[2], meta[4], meta[5], meta[6],
+                       fp_def)
                 if level is None or got != want:
                     os.unlink(f)
                     continue
@@ -1772,6 +1907,7 @@ class JaxChecker:
                 ck = self._load_checkpoint(
                     resume_from, device_visited=self.host_store is None
                 )
+                self._check_fp_def(ck["fp_def"], resume_from)
                 if self.host_store is not None:
                     # a monolith of a device-store run resumes onto the
                     # external tier: its visited array IS the fingerprint
@@ -1790,7 +1926,7 @@ class JaxChecker:
         else:
             st0 = init_batch(cfg, 1)
             n_f = 1
-            fv, _ff, _ms = self.fpr.state_fingerprints(st0)
+            fv, _ff = self._fp_states(st0)
             if self.host_store is not None:
                 self.host_store.insert(np.asarray(fv.astype(U64)))
                 visited = jnp.full((64,), SENT, U64)
@@ -1845,6 +1981,21 @@ class JaxChecker:
         while n_f > 0:
             if max_depth is not None and depth >= max_depth:
                 break
+            if self.presize and len(level_sizes) > PRESIZE_MIN_LEVELS:
+                self._update_presize(level_sizes, distinct, max_depth,
+                                     frontier)
+                if (self.host_store is None
+                        and self._presize_vcap > visited.shape[0]):
+                    # SENT-pad the sorted store up front so its shape is
+                    # pinned for the rest of the run (SENT sorts last, so
+                    # appending keeps the array sorted)
+                    visited = jnp.concatenate([
+                        visited,
+                        jnp.full(
+                            (self._presize_vcap - visited.shape[0],),
+                            SENT, U64,
+                        ),
+                    ])
             # --- expand + compact-then-dedup (device), fused level fetch -
             while True:
                 (n_new, new_fps, new_payload, abort_at, overflow, overflow_g,
@@ -1940,10 +2091,15 @@ class JaxChecker:
             if self.host_store is None:
                 # merge, then trim the store to a pow4 capacity >= distinct;
                 # new_fps is survivor-compacted, so slicing keeps every
-                # real fingerprint and bounds the sort input
-                visited = _merge_sorted(
-                    visited, new_fps[: max(_pow2(n_new), self.chunk)]
-                )[: _cap4(distinct + 1)]
+                # real fingerprint and bounds the sort input.  The presize
+                # floors pin both widths so deep runs reuse one compiled
+                # merge instead of one per magnitude.
+                w = max(_pow2(n_new), self.chunk)
+                if self._presize_merge:
+                    w = max(w, min(self._presize_merge, new_fps.shape[0]))
+                visited = _merge_sorted(visited, new_fps[:w])[
+                    : max(_cap4(distinct + 1), self._presize_vcap)
+                ]
             n_f = n_new
 
             if self.progress is not None:

@@ -1,0 +1,140 @@
+"""Predictive frontier-growth forecasting for capacity pre-sizing.
+
+Growth-triggered capacity changes recompile the full level program —
+minutes per shape on a real mesh, hours when they cascade (the round-4
+depth-14 virtual-mesh attempt died on reactive cap_x doubling:
+docs/MESH_DEEP.json).  This module turns the measured frontier-growth
+model from BASELINE.md into a forecast the engines use to size
+capacities ONCE for the whole run, so each program shape compiles once.
+
+The model: on BFS level n the new-state count grows by a ratio r_n that
+decays roughly linearly with depth (measured on the reference config:
+r drops ~0.017-0.03 per level through the 10^8-state range, BASELINE.md
+"golden counts").  Extrapolation marches the last observed ratio down by
+the observed decay; errors land well inside the pow2 rounding the
+capacity layer applies (from 20 observed levels the level-28 forecast is
+within 5% of the measured 45.1M).
+
+Reference analog: TLC sizes its fingerprint set and queue up front from
+-Xmx heap flags (/root/reference/myrun.sh:3) rather than reallocating
+mid-run; here the "heap flag" is derived from the spec's own measured
+growth curve instead of hand tuning.
+"""
+
+from __future__ import annotations
+
+# measured ratio decay per level on the reference sweep (BASELINE.md);
+# used when fewer than 4 level ratios have been observed
+DEFAULT_DECAY = 0.017
+# forecasts from fewer observed levels than this are noise (early BFS
+# ratios on the reference family swing 1.0-3.0)
+MIN_LEVELS = 6
+# capacity decisions trust the model at most this many levels ahead: a
+# short noisy prefix extrapolates to nonsense at long range (a 14-state
+# observed prefix once "forecast" a 3x10^10-state level and the presize
+# tried to compile a 67M-lane program).  The per-level ratchet re-floors
+# with ever-better forecasts as real levels land, so a long run pays a
+# handful of planned resizes instead of one giant wrong one.
+PRESIZE_HORIZON = 8
+
+
+def pow2ceil(n: int) -> int:
+    """Smallest power of two >= n (>= 1)."""
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+def _ratio_model(level_sizes) -> tuple[float, float]:
+    """(last growth ratio, per-level ratio decay) from observed levels."""
+    f = [int(x) for x in level_sizes if x > 0]
+    if len(f) < 2:
+        return 3.0, DEFAULT_DECAY  # early fan-out: conservative
+    ratios = [f[i] / f[i - 1] for i in range(1, len(f))]
+    r = ratios[-1]
+    # the decay itself shrinks with depth, so estimate from the LAST
+    # three ratio steps only (median: one skewed level can't bend it);
+    # measured on the golden record this tracks the forward decay
+    # within ~7% over an 8-level horizon
+    diffs = [
+        ratios[i - 1] - ratios[i]
+        for i in range(max(1, len(ratios) - 3), len(ratios))
+    ]
+    if diffs:
+        diffs.sort()
+        d = diffs[len(diffs) // 2]
+    else:
+        d = DEFAULT_DECAY
+    # clamp: negative observed decay (noise) would forecast super-
+    # exponential growth; huge decay would truncate the run to nothing.
+    # Both clamps are conservative for CAPACITY use (they over-predict).
+    return r, min(0.08, max(0.005, d))
+
+
+def forecast_new_states(
+    level_sizes,
+    target_depth: int | None,
+    max_levels: int = 128,
+) -> list[int]:
+    """Extrapolated per-level new-state counts beyond the observed prefix.
+
+    ``level_sizes``: observed new states for levels 0..L (level 0 is the
+    single init state).  Returns forecasts for levels L+1..target_depth;
+    with ``target_depth=None`` (fixpoint run) the projection runs until
+    the modeled frontier decays below 1 state or ``max_levels`` is hit.
+    Empty when the target is already reached or there is no signal yet.
+    """
+    obs = [int(x) for x in level_sizes]
+    depth_now = len(obs) - 1
+    if depth_now < 1 or (target_depth is not None and target_depth <= depth_now):
+        return []
+    r, d = _ratio_model(obs)
+    if target_depth is None:
+        # open horizon: a noise-floored decay would extrapolate early
+        # ratios into astronomically large "fixpoints" (observed: 10^29
+        # on a 50-state config).  Force at least the measured reference
+        # decay, and below: trust the projection only if it CONVERGES.
+        d = max(d, DEFAULT_DECAY)
+    out: list[int] = []
+    f = float(obs[-1])
+    level = depth_now
+    while len(out) < max_levels:
+        level += 1
+        if target_depth is not None and level > target_depth:
+            break
+        r = max(0.05, r - d)
+        f = f * r
+        if f < 1.0:
+            break
+        out.append(int(f) + 1)
+    if target_depth is None and len(out) >= max_levels:
+        return []  # projection never reached a fixpoint: no usable signal
+    return out
+
+
+def horizon_forecast(level_sizes, distinct: int, target_depth: int | None):
+    """The one shared presize signal: (peak_new, final_distinct, budget).
+
+    Horizon-limited (PRESIZE_HORIZON) per-level forecast plus the
+    TLA_RAFT_PRESIZE_BYTES budget, parsed in exactly one place so the
+    two engines cannot drift on the model (they still apply their own
+    engine-specific margins and pow2 quantization to these numbers).
+    Returns None when there is no usable signal yet.
+    """
+    import os
+
+    fut = forecast_new_states(level_sizes, target_depth)[:PRESIZE_HORIZON]
+    if not fut:
+        return None
+    budget = int(float(os.environ.get("TLA_RAFT_PRESIZE_BYTES", "4e9")))
+    return max(fut), distinct + sum(fut), budget
+
+
+def forecast_final_distinct(level_sizes, distinct: int,
+                            target_depth: int | None) -> int:
+    """Forecast total distinct states at the end of the run."""
+    return distinct + sum(forecast_new_states(level_sizes, target_depth))
+
+
+def forecast_peak_new(level_sizes, target_depth: int | None) -> int:
+    """Forecast the largest per-level new-state count over the run."""
+    fut = forecast_new_states(level_sizes, target_depth)
+    return max(fut, default=0)

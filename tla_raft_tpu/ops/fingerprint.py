@@ -536,6 +536,229 @@ class Fingerprinter:
         h = self.feat_hash(feats) + parent_msum + self.delta_hash(ids, live)
         return self.finalize(h)
 
+    # -- orbit pruning (canonical-relabel fast path) -----------------------
+    #
+    # The P-folded min-fingerprint costs O(P) matmul columns per state —
+    # fine at S=3 (P=6) but the dominant compute at S=7 (P=5040, the
+    # north-star config 5).  Most non-trivial states are ASYMMETRIC: a
+    # cheap Weisfeiler–Leman-style per-server coloring from view-covariant
+    # data (currentTerm, role, log, match/nextIndex, votedFor, per-pair
+    # message multisets) distinguishes all S servers, which pins a unique
+    # canonical relabeling σ (sort by color).  For such "discrete" states
+    # the orbit-invariant fingerprint is the hash at that ONE permutation
+    # — computed with base (identity) coefficient tables after permuting
+    # the feature vector and message bitmask by σ, ~P× less work than the
+    # fold.  States with color ties (symmetric early states, or color
+    # collisions) fall back to the exact min-over-P path; both routes are
+    # orbit-invariant and orbit-mates always take the same route (the
+    # color multiset is itself orbit-invariant), so distinct-state counts
+    # are unchanged.  NOTE the fingerprint VALUES differ from the
+    # min-over-P definition, so runs must not mix the two definitions in
+    # one visited store (engine flag TLA_RAFT_ORBIT, default off).
+    #
+    # σ is derived from VIEW variables only, so view-equal states get the
+    # same σ and fp_view stays a pure function of the VIEW projection
+    # (the Raft.cfg:26 contract); fp_full then hashes the full state at
+    # that same σ, which is still orbit-invariant because σ is a
+    # covariant function of the view projection.
+
+    @functools.cached_property
+    def _orbit_tables(self):
+        """Device tables for the canonical-relabel path (built on demand)."""
+        from .msg_universe import _dst_idx
+
+        uni, S, P = self.uni, self.cfg.S, self.P
+        NP = S * (S - 1)
+        # feature-permutation rows for every perm: [P, F] i32
+        psi = np.stack(
+            [self.spec.perm_source_indices(p) for p in self.perms]
+        ).astype(np.int32)
+        # inverse pair-digit permutation: ppinv[p, q'] = q with pp[p,q]=q'
+        pp = self.uni.pair_perm_table
+        ppinv = np.empty_like(pp)
+        rows = np.arange(P)[:, None]
+        ppinv[rows, pp] = np.arange(NP)[None, :].astype(pp.dtype)
+        # (src, dst) -> pair digit (1-based servers; diagonal unused)
+        qidx = np.zeros((S, S), np.int32)
+        for src in range(1, S + 1):
+            for dst in range(1, S + 1):
+                if src != dst:
+                    qidx[src - 1, dst - 1] = (src - 1) * (S - 1) + _dst_idx(
+                        src, dst
+                    )
+        # per-type random coefficients for the per-pair message multiset
+        # hash (i32 wraparound arithmetic = mod 2^32 hashing)
+        rng = np.random.default_rng(self.seed ^ 0x0B17)
+        W = [
+            jnp.asarray(
+                rng.integers(-(1 << 31), 1 << 31, size=(s,), dtype=np.int64
+                             ).astype(np.int32)
+            )
+            for s in uni.type_strides
+        ]
+        # identity-permutation (base) coefficient planes
+        C0 = jnp.asarray(
+            np.asarray(self.C_planes).reshape(self.spec.F, P, self.N_CHAN * 4)[
+                :, 0, :
+            ]
+        )
+        G0 = jnp.asarray(
+            _u32_to_i8_planes(
+                self.raw_msg_coef(np.arange(uni.M, dtype=np.uint32))
+            ).reshape(uni.M, self.N_CHAN * 4)
+        )
+        fact = np.ones(S, np.int64)
+        for i in range(S - 2, -1, -1):
+            fact[i] = fact[i + 1] * (S - 1 - i)
+        return dict(
+            psi=jnp.asarray(psi), ppinv=jnp.asarray(ppinv),
+            qidx=jnp.asarray(qidx), W=W, C0=C0, G0=G0,
+            fact=jnp.asarray(fact), NP=NP,
+        )
+
+    def _orbit_pairh(self, bits):
+        """Per-(src,dst)-pair message multiset hash: i8[..., M] -> u32[..., NP]."""
+        tb = self._orbit_tables
+        NP = tb["NP"]
+        lead = bits.shape[:-1]
+        acc = jnp.zeros((*lead, NP), jnp.int32)
+        for (off, stride), W in zip(
+            zip(self.uni.type_offsets, self.uni.type_strides), tb["W"]
+        ):
+            bt = jax.lax.slice_in_dim(
+                bits, off, off + NP * stride, axis=-1
+            ).reshape(*lead, NP, stride).astype(jnp.int32)
+            acc = acc + jnp.einsum("...ns,s->...n", bt, W)
+        return acc.astype(jnp.uint32)
+
+    def _orbit_colors(self, st, pairh):
+        """View-covariant WL colors u32[..., S] (3 refinement rounds)."""
+        u32, S, L = jnp.uint32, self.cfg.S, self.cfg.L
+        tb = self._orbit_tables
+        ct = st.current_term.astype(u32)
+        role = st.role.astype(u32)
+        ll = st.log_len.astype(u32)
+        ci = st.commit_index.astype(u32)
+        lt = st.log_term.astype(u32)
+        lv = st.log_val.astype(u32)
+        mi = st.match_index.astype(u32)
+        ni = st.next_index.astype(u32)
+        vf = st.voted_for.astype(jnp.int32)
+        lpos = jnp.arange(L, dtype=u32) * u32(0x9E3779B9)
+        logh = _mix32(
+            lt * u32(0x85EBCA6B) + lv * u32(0xC2B2AE35) + lpos
+        ).sum(-1, dtype=u32)
+        c = _mix32(
+            ct * u32(0x8DA6B343) + role * u32(0xD8163841)
+            + ll * u32(0xCB1AB31F) + ci * u32(0x165667B1) + logh
+        )
+        # directed-pair data (position-covariant under simultaneous row/
+        # column permutation): per-pair msg hash + match/nextIndex entries
+        ph_ij = pairh[..., tb["qidx"]]  # [..., S(i), S(j)] (diag garbage)
+        ph_ji = pairh[..., tb["qidx"].T]
+        offdiag = ~jnp.eye(S, dtype=bool)
+        mi_d = jnp.diagonal(mi, axis1=-2, axis2=-1).astype(u32)
+        ni_d = jnp.diagonal(ni, axis1=-2, axis2=-1).astype(u32)
+        for _ in range(3):
+            cj = c[..., None, :]  # [..., 1(i), S(j)]
+            e_out = jnp.where(
+                offdiag,
+                _mix32(cj + ph_ij * u32(3) + mi * u32(0x27D4EB2F)
+                       + ni * u32(0x9E3779B1)),
+                u32(0),
+            ).sum(-1, dtype=u32)
+            mi_t = jnp.swapaxes(mi, -1, -2)
+            ni_t = jnp.swapaxes(ni, -1, -2)
+            e_in = jnp.where(
+                offdiag,
+                _mix32(cj + ph_ji * u32(5) + mi_t * u32(0x85EBCA77)
+                       + ni_t * u32(0xC2B2AE3D)),
+                u32(0),
+            ).sum(-1, dtype=u32)
+            cvf = jnp.take_along_axis(
+                c, jnp.clip(vf - 1, 0, S - 1), axis=-1
+            )
+            vfh = jnp.where(
+                vf == 0, u32(0x94D049BB), _mix32(cvf + u32(0xBF58476D))
+            )
+            c = _mix32(
+                c * u32(0xFF51AFD7) + e_out + e_in + vfh
+                + mi_d * u32(0xE6546B64) + ni_d * u32(0x2545F491)
+            )
+        return c
+
+    def _orbit_rank(self, colors):
+        """(lexicographic perm rank i64[...], discrete bool[...]).
+
+        The canonical perm maps each server to 1 + (#servers with a
+        smaller color) — i.e. sorts servers by color — and its index in
+        ``server_perms()`` (itertools lexicographic order) is the Lehmer
+        rank of the image sequence.  Only meaningful where ``discrete``.
+        """
+        tb = self._orbit_tables
+        ci = colors[..., :, None]
+        cj = colors[..., None, :]
+        S = self.cfg.S
+        p = (cj < ci).sum(-1).astype(jnp.int64)  # 0-based images
+        eq = (ci == cj) & ~jnp.eye(S, dtype=bool)
+        discrete = ~eq.any(axis=(-2, -1))
+        after = jnp.triu(jnp.ones((S, S), bool), k=1)
+        code = ((p[..., None, :] < p[..., :, None]) & after).sum(-1)
+        rank = (code * tb["fact"]).sum(-1)
+        return rank, discrete
+
+    def _plane_matmul_flat(self, x_i8, table):
+        """i8[..., D] x [D, NC*4] -> u32[..., NC] (same CPU guard as
+        ``_plane_matmul``, single permutation column)."""
+        if jax.default_backend() == "cpu":
+            out = jnp.dot(x_i8.astype(jnp.int32), table.astype(jnp.int32))
+        else:
+            out = jnp.dot(x_i8, table, preferred_element_type=jnp.int32)
+        return _combine_planes_u32(
+            out.reshape(*x_i8.shape[:-1], self.N_CHAN, 4)
+        )
+
+    def state_fingerprints_orbit(self, st):
+        """(fp_view u64[...], fp_full u64[...], discrete bool[...]).
+
+        Fingerprints are EXACT canonical hashes only where ``discrete``;
+        other rows need the min-over-P fallback (``state_fingerprints``).
+        Where discrete, the value equals the standard per-permutation
+        hash evaluated at the canonical perm (bit-identical to that
+        column of the folded table path — asserted in tests/test_orbit).
+        """
+        tb = self._orbit_tables
+        bits = self.unpack_bits(st.msgs)
+        pairh = self._orbit_pairh(bits)
+        colors = self._orbit_colors(st, pairh)
+        rank, discrete = self._orbit_rank(colors)
+        lead = bits.shape[:-1]
+        # features permuted by the canonical perm, hashed at base coeffs
+        feats = self.spec.features(st)
+        psi = tb["psi"][rank]  # [..., F]
+        fplanes = jnp.take_along_axis(feats, psi, axis=-1)
+        # message bitmask permuted arithmetically: only the pair digit of
+        # an id moves under a server perm, so permute the q axis of each
+        # type block by the inverse pair map and hash against base coeffs
+        ppinv_row = tb["ppinv"][rank]  # [..., NP]
+        NP = tb["NP"]
+        parts = []
+        for off, stride in zip(self.uni.type_offsets, self.uni.type_strides):
+            bt = jax.lax.slice_in_dim(
+                bits, off, off + NP * stride, axis=-1
+            ).reshape(*lead, NP, stride)
+            btp = jnp.take_along_axis(bt, ppinv_row[..., None], axis=-2)
+            parts.append(btp.reshape(*lead, NP * stride))
+        bits_perm = jnp.concatenate(parts, axis=-1)
+        h = (
+            self._plane_matmul_flat(fplanes, tb["C0"])
+            + self._plane_matmul_flat(bits_perm, tb["G0"])
+        )
+        h64 = h.astype(jnp.uint64)
+        view = (h64[..., 0] << jnp.uint64(32)) | h64[..., 1]
+        full = (h64[..., 2] << jnp.uint64(32)) | h64[..., 3]
+        return view, full, discrete
+
     # -- numpy reference path (oracle bridge, tests) -----------------------
 
     def fingerprints_np(self, arrs: dict, msgs_bits: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
 import time
 from typing import NamedTuple
 
@@ -90,6 +91,7 @@ class Phase1Out(NamedTuple):
     abort: jnp.ndarray  # bool[] any split-brain abort (psum'd)
     abort_at: jnp.ndarray  # i64[1]
     overflow_x: jnp.ndarray  # bool[] candidate/routing capacity exceeded
+    cand_max: jnp.ndarray  # i64[] max per-device candidate count (pmax'd)
 
 
 class Phase2Out(NamedTuple):
@@ -121,6 +123,9 @@ class LevelOut(NamedTuple):
     abort_at: jnp.ndarray  # i64[1] local frontier index of first abort or -1
     overflow_x: jnp.ndarray  # bool[] candidate/routing capacity exceeded
     overflow_v: jnp.ndarray  # bool[] visited-shard capacity exceeded
+    cand_max: jnp.ndarray  # i64[] max per-device candidate count (pmax'd)
+    # cand_max feeds the presize forecast an OBSERVED candidates-per-new
+    # ratio, replacing the hand-tuned margin that under-sized cap_x
 
 
 class CheckResult(NamedTuple):
@@ -152,6 +157,13 @@ class ShardedChecker:
       vcap:  per-device visited-shard capacity (all_to_all mode; grows on
              demand by the host driver).
       exchange: "all_to_all" (sharded store) or "all_gather" (replicated).
+      cap_x_max: ceiling for PREDICTIVE cap_x sizing only (run(presize=
+             True)).  The growth forecast can overshoot ~2x early in a
+             run, and at pow2 granularity that doubles the one big
+             compile; an operator who has measured the real candidate
+             peak (e.g. scripts/mesh_deep_parity.py) clamps the forecast
+             here.  Reactive overflow growth ignores the ceiling — it is
+             a sizing hint, never a correctness bound.
     """
 
     def __init__(
@@ -164,6 +176,7 @@ class ShardedChecker:
         progress=None,
         canon: str = "late",
         host_store_dir: str | None = None,
+        cap_x_max: int | None = None,
     ):
         assert exchange in ("all_to_all", "all_gather")
         # mesh x external store (VERDICT r3 missing #4 / next #6): the
@@ -196,8 +209,13 @@ class ShardedChecker:
         self.K = self.kern.K
         self.D = mesh.devices.size
         self.cap_x = cap_x
+        self.cap_x_max = cap_x_max
         self.vcap = vcap
         self.exchange = exchange
+        # reactive (mid-level) growth events this run: each one is a
+        # full level-program recompile the presize forecast should have
+        # prevented — scripts surface it (docs/MESH_DEEP.json)
+        self.reactive_grows = 0
         self.progress = progress
         self.inv_fns = [(n, resolve_invariant_kernel(n)) for n in cfg.invariants]
 
@@ -293,6 +311,7 @@ class ShardedChecker:
         (cv, cf, cp, mult_slots, abort, abort_at, overflow, dev, cap_f) = (
             self._expand_local(frontier, msum, n_f)
         )
+        n_cand = (cv != SENT).sum().astype(I64)  # pre-dedup: cap_x load
         pos = jnp.searchsorted(visited, cv)
         hit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == cv
         cv = jnp.where(hit, SENT, cv)
@@ -322,6 +341,7 @@ class ShardedChecker:
             inv_bad, first_bad[None], abort, abort_at[None],
             jax.lax.psum(overflow.astype(I32), "d") > 0,
             jnp.zeros((), bool),
+            jax.lax.pmax(n_cand, "d"),
         )
 
     def _body_all_to_all(self, frontier, msum, n_f, visited):
@@ -397,6 +417,7 @@ class ShardedChecker:
             inv_bad, first_bad[None], abort, abort_at[None],
             jax.lax.psum(overflow_x.astype(I32), "d") > 0,
             jax.lax.psum(overflow_v.astype(I32), "d") > 0,
+            jax.lax.pmax(counts[:D].sum().astype(I64), "d"),
         )
 
     # -- host-store mode: the level split into two collective programs ----
@@ -428,6 +449,7 @@ class ShardedChecker:
         return Phase1Out(
             cv, cf, cp, rv, rf, rp, mult_slots, abort, abort_at[None],
             jax.lax.psum(overflow_x.astype(I32), "d") > 0,
+            jax.lax.pmax(counts[:D].sum().astype(I64), "d"),
         )
 
     def _body_a2a_phase2(self, frontier, cv, cp, verdict_recv, n_f):
@@ -503,7 +525,7 @@ class ShardedChecker:
                 in_specs=(spec_state, P("d"), P("d")),
                 out_specs=Phase1Out(
                     P("d"), P("d"), P("d"), P("d"), P("d"), P("d"),
-                    P(), P(), P("d"), P(),
+                    P(), P(), P("d"), P(), P(),
                 ),
                 check_vma=False,
             )
@@ -543,13 +565,14 @@ class ShardedChecker:
                     f"cap_r={self.cap_r})"
                 )
             grows += 1
+            self.reactive_grows += 1
             self.cap_x *= 2
             for k in ("level_phase1", "level_phase2", "cap_r"):
                 self.__dict__.pop(k, None)
         generated = p1.mult_slots.sum()
         common = dict(
             mult_slots=p1.mult_slots, generated=generated, visited=None,
-            abort=p1.abort, abort_at=p1.abort_at,
+            abort=p1.abort, abort_at=p1.abort_at, cand_max=p1.cand_max,
             overflow_x=jnp.zeros((), bool), overflow_v=jnp.zeros((), bool),
         )
         if bool(p1.abort):
@@ -579,9 +602,14 @@ class ShardedChecker:
 
     @functools.cached_property
     def cap_r(self) -> int:
-        # routing capacity per (src, dst) pair: uniform hashing concentrates
-        # counts near cap_x/D; 4x slack + floor keeps overflow retries rare
-        return max(256, 4 * self.cap_x // self.D)
+        # routing capacity per (src, dst) pair.  Duplicate fan-out lanes
+        # CONCENTRATE on their child's owner (same fp -> same shard), so
+        # uniform-hashing slack under-provisions skewed levels (measured:
+        # reactive cap_x doublings at levels 9-10 of the reference config
+        # were routing overflows).  cap_r = cap_x is worst-case exact —
+        # per-owner count can never exceed the device's candidate total —
+        # and the D*cap_r all_to_all buffers stay MB-scale.
+        return self.cap_x
 
     @functools.cached_property
     def level_step(self):
@@ -601,6 +629,7 @@ class ShardedChecker:
                     jax.tree.map(lambda _: P("d"), init_batch(self.cfg, 1)),
                     P("d"), vspec, P("d"), P(), P(), P(),
                     P("d"), P("d"), P(), P("d"), P(), P("d"), P(), P(),
+                    P(),
                 ),
                 # the scatter-in-switch inside materialize trips the vma
                 # (varying-axis) type checker; the body is plain SPMD with
@@ -875,6 +904,7 @@ class ShardedChecker:
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 1,
         resume_from: str | None = None,
+        presize: bool = True,
     ) -> CheckResult:
         cfg, D = self.cfg, self.D
         mesh = self.mesh
@@ -976,9 +1006,73 @@ class ShardedChecker:
                 jnp.asarray(np.concatenate([arr, pad], axis=1)).reshape(-1), shard
             )
 
+        # predictive capacity sizing (VERDICT r4 #7): once enough levels
+        # are observed to trust the growth model, size cap_x/vcap for the
+        # WHOLE remaining run in one step, so reactive growth — a full
+        # level-program recompile per doubling, the round-4 depth-14
+        # killer (docs/MESH_DEEP.json) — never fires.  Re-checked every
+        # level; only grows (a later, better forecast can top it up, but
+        # typically this resizes exactly once).  The reactive loops below
+        # stay as the backstop for forecast misses.
+        from ..engine.forecast import (
+            MIN_LEVELS, horizon_forecast, pow2ceil,
+        )
+        self._gather_keep = 0  # all_gather: forecast floor for store trim
+        self._cand_hist = []  # per-level max-device candidates / new states
+
+        def maybe_presize(visited):
+            sig = horizon_forecast(level_sizes, distinct, max_depth)
+            if sig is None:
+                return visited
+            peak_new, final_distinct, budget = sig
+            # cap_x holds one device's candidates for a level — forecast
+            # it from the MEASURED per-device candidates-per-new ratio
+            # (duplicate fan-out lanes make the hand-modeled ratio
+            # undershoot at shallow depths; cand_max tracks the truth)
+            r_cd = max(self._cand_hist[-3:]) if self._cand_hist else 4.0 / D
+            want_x = pow2ceil(int(r_cd * peak_new * 1.25) + 1)
+            if self.cap_x_max is not None:
+                want_x = min(want_x, self.cap_x_max)
+            # absolute backstops: a forecast gone wrong must degrade to
+            # the reactive path, never to an absurd allocation/compile.
+            # With cap_r = cap_x, the six all_to_all routing buffers cost
+            # 48*D bytes per cap_x lane — keep them inside the budget.
+            want_x = min(want_x, 1 << 22, pow2ceil(budget // (48 * D)) // 2)
+            if want_x > self.cap_x:
+                print(
+                    f"[mesh] presize: cap_x {self.cap_x} -> {want_x} "
+                    f"(forecast peak {peak_new}/level over "
+                    f"{len(fut)} remaining levels)", file=sys.stderr,
+                )
+                self.cap_x = want_x
+                for k in ("level_step", "level_phase1", "level_phase2",
+                          "cap_r"):
+                    self.__dict__.pop(k, None)
+            if self.host_stores is None and self.exchange == "all_to_all":
+                # reactive trigger is distinct > D*vcap//2; stay under it
+                want_v = pow2ceil(int(2.2 * final_distinct / D) + 1)
+                want_v = min(want_v, pow2ceil(budget // (8 * D)))
+                if want_v > self.vcap:
+                    print(
+                        f"[mesh] presize: vcap {self.vcap} -> {want_v} "
+                        f"(forecast {final_distinct} final distinct)",
+                        file=sys.stderr,
+                    )
+                    visited = grow_visited(visited, want_v)
+            elif self.host_stores is None:  # all_gather
+                # ratchet only — a later, lower forecast must not shrink
+                # the trim floor (shrinking mints a new store shape)
+                self._gather_keep = max(self._gather_keep, min(
+                    pow2ceil(int(1.05 * final_distinct)),
+                    pow2ceil(budget // 8),
+                ))
+            return visited
+
         while True:
             if max_depth is not None and depth >= max_depth:
                 break
+            if presize and len(level_sizes) > MIN_LEVELS:
+                visited = maybe_presize(visited)
             if self.host_stores is not None:
                 out = self._hosted_level(frontier, msum, n_f)
             else:
@@ -998,6 +1092,13 @@ class ShardedChecker:
                             f"vcap={self.vcap})"
                         )
                     grows += 1
+                    self.reactive_grows += 1
+                    print(
+                        f"[mesh] REACTIVE grow at level {depth + 1}: "
+                        f"{'vcap' if bool(out.overflow_v) else 'cap_x'} "
+                        f"(cap_x={self.cap_x}, cap_r={self.cap_r}, "
+                        f"vcap={self.vcap})", file=sys.stderr,
+                    )
                     if bool(out.overflow_v):
                         visited = grow_visited(visited, self.vcap * 4)
                     else:
@@ -1029,6 +1130,7 @@ class ShardedChecker:
             cap_f_prev = frontier.voted_for.shape[0] // D
             distinct += n_new
             level_sizes.append(n_new)
+            self._cand_hist.append(int(np.asarray(out.cand_max)) / n_new)
             depth += 1
             trace_levels.append(
                 (np.asarray(out.gpidx).astype(np.int64),
@@ -1039,9 +1141,22 @@ class ShardedChecker:
                 if self.exchange == "all_gather":
                     # the replicated store grows by D*cap_x sentinel-padded
                     # slots per level; trim back to the tightest pow2 that
-                    # holds every distinct fp (store is sorted, SENT-padded)
-                    keep = max(4096, 1 << distinct.bit_length())
-                    visited = jax.device_put(out.visited[:keep], repl)
+                    # holds every distinct fp (store is sorted, SENT-
+                    # padded).  The presize forecast floors the trim so
+                    # the store shape stays constant over the run instead
+                    # of stepping through every magnitude (one level-step
+                    # compile per magnitude otherwise); SENT-pad up to the
+                    # floor when the merged store is still shorter (SENT
+                    # sorts last, so the pad keeps the array sorted).
+                    keep = max(4096, 1 << distinct.bit_length(),
+                               self._gather_keep)
+                    vis = out.visited[:keep]
+                    if vis.shape[0] < keep:
+                        vis = jnp.concatenate([
+                            vis,
+                            jnp.full((keep - vis.shape[0],), SENT, U64),
+                        ])
+                    visited = jax.device_put(vis, repl)
             frontier, msum = out.children, out.child_msum
             n_f = jax.device_put(out.n_new_local, shard)
             if self.progress is not None:
