@@ -10,9 +10,13 @@ both in a live run and in a delta-log replay.
 
 import numpy as np
 
+import pytest
+
 from tla_raft_tpu.config import RaftConfig
 from tla_raft_tpu.engine import JaxChecker
 from tla_raft_tpu.oracle import OracleChecker
+
+pytestmark = pytest.mark.slow
 
 CFG = RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1)
 

@@ -3,6 +3,8 @@
 
 import os
 
+import pytest
+
 from tla_raft_tpu.config import RaftConfig
 from tla_raft_tpu.engine import JaxChecker
 from tla_raft_tpu.oracle import OracleChecker
@@ -30,6 +32,7 @@ def test_resume_matches_uninterrupted_run(tmp_path):
     assert resumed.generated == want.generated
 
 
+@pytest.mark.slow
 def test_resume_preserves_violation_traces(tmp_path):
     """A violation found after a delta-log resume still yields a genuine,
     full-depth counterexample trace (the replay rebuilds every level's

@@ -40,6 +40,7 @@ def test_reference_config_matches_oracle(binary):
     assert got["generated"] == want.generated
 
 
+@pytest.mark.slow
 def test_small_configs_match_oracle(binary):
     from tla_raft_tpu.config import RaftConfig
     from tla_raft_tpu.oracle import OracleChecker

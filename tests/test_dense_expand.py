@@ -67,6 +67,7 @@ def test_dense_matches_scalar(cfg):
     assert np.array_equal(np.asarray(dense.abort), np.asarray(ref.abort))
 
 
+@pytest.mark.slow
 def test_dense_matches_scalar_s5():
     import dataclasses
 

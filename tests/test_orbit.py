@@ -114,6 +114,7 @@ def test_lehmer_rank_matches_perm_order(S):
     assert list(np.asarray(rank)) == list(range(len(perms)))
 
 
+@pytest.mark.slow
 def test_orbit_matches_fold_column():
     fpr = Fingerprinter(CFG)
     st, _bits = _random_states(CFG, 256)
@@ -138,6 +139,7 @@ def test_orbit_matches_fold_column():
     np.testing.assert_array_equal(np.asarray(ff)[sel], want_f[sel])
 
 
+@pytest.mark.slow
 def test_orbit_invariance_under_relabeling():
     fpr = Fingerprinter(CFG)
     st, bits = _random_states(CFG, 128, seed=7)
@@ -161,7 +163,11 @@ def test_init_state_is_symmetric_not_discrete():
     "cfg",
     [
         RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1),
-        RaftConfig(n_servers=3, n_vals=1, max_election=1, max_restart=0),
+        pytest.param(
+            RaftConfig(n_servers=3, n_vals=1, max_election=1,
+                       max_restart=0),
+            marks=pytest.mark.slow,
+        ),
     ],
     ids=["s2", "s3"],
 )

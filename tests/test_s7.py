@@ -87,6 +87,7 @@ def test_factored_hash_bit_identical(n_servers):
     assert np.array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_guards_and_children_match_oracle_s7(cfg7):
     """Sampled differential: guards-only expand + materialized-child
     fingerprints against the oracle's successor sets."""
@@ -121,6 +122,7 @@ def test_guards_and_children_match_oracle_s7(cfg7):
         assert got == want, f"state {i}"
 
 
+@pytest.mark.slow
 def test_engine_parity_s7(cfg7):
     """Full BFS parity engine-vs-oracle on the bounded 7-server space."""
     o = OracleChecker(cfg7).run(max_depth=4)
@@ -131,6 +133,7 @@ def test_engine_parity_s7(cfg7):
     assert e.distinct == o.distinct
 
 
+@pytest.mark.slow
 def test_engine_parity_s7_orbit(cfg7, monkeypatch):
     """BFS parity with orbit pruning engaged at S=7 (P=5040): the
     canonical-relabel fast path plus the compacted fold fallback must

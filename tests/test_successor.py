@@ -75,6 +75,7 @@ def test_expand_matches_oracle(cfg):
         assert got == want, f"state {i}"
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("cfg", CFGS, ids=["s2", "s3"])
 def test_materialize_matches_oracle(cfg):
     kern = SuccessorKernel(cfg)
