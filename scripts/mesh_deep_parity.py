@@ -70,11 +70,13 @@ def main():
     # the first trustworthy level and resizes BEFORE compiling, so
     # growth-triggered recompiles of the 8-device collective program
     # (>1 h each on this 1-core host — the round-4 depth-14 killer)
-    # never fire.  The script only supplies the measured candidate-peak
-    # CEILING (level 14 carries ~20k candidates/device) so an early
-    # forecast overshoot can't double the one big compile's shape.
+    # never fire.  The script only supplies a measured candidate-peak
+    # CEILING so a forecast overshoot can't inflate the one big compile.
+    # Level 14 measured: pre-dedup candidates exceed 32k on the peak
+    # device (the round-4 "20k/device" note undercounted duplicates) —
+    # the engine's own unclamped forecast (65536) is the right size.
     cap_x = 8192
-    cap_x_max = 8192 if depth <= 13 else 32768
+    cap_x_max = 8192 if depth <= 13 else 65536
     t0 = time.monotonic()
     levels = []
 

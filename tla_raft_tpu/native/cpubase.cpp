@@ -695,6 +695,19 @@ int run(const Cfg &cfg, int max_depth, int n_threads) {
     level_sizes.push_back(next.size());
     depth++;
     frontier.swap(next);
+    {
+      // per-level progress so a crashed/killed deep run still leaves a
+      // usable record on stderr (the JSON only prints at the end)
+      double el = std::chrono::duration<double>(
+                      std::chrono::steady_clock::now() - t0).count();
+      std::fprintf(stderr,
+                   "[cpubase] level %d: new %llu, distinct %llu, "
+                   "generated %llu, %.0fs\n",
+                   depth, (unsigned long long)level_sizes.back(),
+                   (unsigned long long)distinct,
+                   (unsigned long long)generated.load(), el);
+      std::fflush(stderr);
+    }
     if (inv_bad) {
       std::fprintf(stderr, "Invariant Inv violated at depth %d\n", depth);
       return 1;

@@ -1041,8 +1041,8 @@ class ShardedChecker:
             if want_x > self.cap_x:
                 print(
                     f"[mesh] presize: cap_x {self.cap_x} -> {want_x} "
-                    f"(forecast peak {peak_new}/level over "
-                    f"{len(fut)} remaining levels)", file=sys.stderr,
+                    f"(forecast peak {peak_new}/level, measured "
+                    f"cand/new ratio {r_cd:.2f})", file=sys.stderr,
                 )
                 self.cap_x = want_x
                 for k in ("level_step", "level_phase1", "level_phase2",
