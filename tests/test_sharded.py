@@ -222,3 +222,27 @@ def test_sharded_presize_prevents_reactive_growth():
     assert chk.reactive_grows == 0, (
         f"{chk.reactive_grows} reactive growth events despite presize"
     )
+
+
+def test_children_are_owner_balanced(tmp_path):
+    """The owner-shipping exchange must spread the next frontier across
+    the mesh (rounds 2-4 kept children with their parents, so the whole
+    frontier cascaded from device 0 and the mesh balanced nothing —
+    the round-4 depth-13 chain records n_local=[N,0,...] everywhere).
+    The mdelta log records per-device counts; at a level with hundreds
+    of states all 8 owners must hold a share."""
+    import numpy as np
+
+    from tla_raft_tpu.cfgparse import load_raft_config
+
+    cfg = load_raft_config("/root/reference/Raft.cfg")
+    ck = str(tmp_path / "bal")
+    res = ShardedChecker(cfg, make_mesh(8), cap_x=512, vcap=4096).run(
+        max_depth=8, checkpoint_dir=ck
+    )
+    assert res.ok and res.level_sizes[-1] == 931
+    z = np.load(f"{ck}/mdelta_0008.npz")
+    nl = z["n_local"]
+    assert (nl > 0).all(), f"frontier not owner-balanced: {nl}"
+    # hash-uniform: no device should hold more than ~3x its fair share
+    assert nl.max() <= 3 * (931 // 8), f"skewed: {nl}"

@@ -3,9 +3,15 @@
 The reference's only parallelism is TLC's shared-memory worker pool
 (``-workers 4``, /root/reference/myrun.sh:3); its distributed mode is
 unused.  The TPU-native replacement shards the **frontier** over a 1-D
-device mesh axis ``d`` (each device expands and materializes its own
-states — full states never cross the interconnect) and exchanges only
-64-bit fingerprints per BFS level.  Two exchange strategies:
+device mesh axis ``d``: each device expands its own states, candidate
+fingerprints are exchanged for dedup, and (all_to_all mode) each NEW
+state's full ~700 B crosses the interconnect exactly once — origin to
+owner shard (``fp % D``) — so the next frontier is hash-balanced across
+devices.  (Rounds 2-4 kept children on their parents' device; since
+everything descends from the one init state, the whole frontier stayed
+on device 0 and the mesh load-balanced nothing — the round-4 depth-13
+chain records n_local = [N, 0, ..., 0] at every level.)  Two exchange
+strategies:
 
 * ``all_gather`` (small scale): each device locally pre-dedups its
   candidate fingerprints (lexsort + unique), an ``all_gather`` shares the
@@ -103,6 +109,8 @@ class Phase2Out(NamedTuple):
     slots: jnp.ndarray
     inv_bad: jnp.ndarray
     inv_bad_at: jnp.ndarray  # i64[1]
+    ovf_w: jnp.ndarray  # bool[] (origin, owner) shipping rows exceeded
+    ovf_c: jnp.ndarray  # bool[] an owner's frontier block overflowed
 
 
 class LevelOut(NamedTuple):
@@ -276,6 +284,114 @@ class ShardedChecker:
         )
         return cv, cf, cp, mult_slots, abort, abort_at, overflow, dev, cap_f
 
+    def _ship_winners_to_owners(self, frontier, cap_f, dev, oo, op,
+                                win_sorted):
+        """Materialize winning children at their ORIGIN (the parent's
+        device) and route the full child states to their OWNER shard
+        (fp % D) with one all_to_all per field.
+
+        This is the load-balancing half the rounds 2-4 mesh never had:
+        children used to stay with their parents, so the entire frontier
+        cascaded from the init state's device and D-1 devices idled
+        while device 0's candidate caps blew up (measured: the round-4
+        depth-13 chain's n_local is [N, 0, ..., 0] at every level).
+        Owner-claiming spreads the next frontier ~uniformly (fingerprints
+        are pseudorandom), shrinking per-device expand load and cap_x by
+        ~D.  Traffic: ~700 B/state origin->owner once per state lifetime
+        — well inside ICI budgets, and the fp-only dedup exchanges are
+        unchanged.
+
+        Inputs are in owner-grouped candidate order (``oo`` = owner per
+        lane, ``op`` = payload per lane, ``win_sorted`` = this origin's
+        winners).  Returns (children, child_msum, gpidx, slots, lane,
+        n_new_local, inv_bad, first_bad, ovf_w, ovf_c) — ``ovf_w``:
+        some (origin, owner) pair exceeded the cap_w shipping rows
+        (fix: grow cap_w); ``ovf_c``: an owner received more new states
+        than its cap_x frontier block (fix: grow cap_x).
+        """
+        D, K = self.D, self.K
+        cap_w = self.cap_w
+        # winners are contiguous per owner group after a stable sort on
+        # (not-winner, owner): group o's winners land at rows
+        # wstarts[o] .. wstarts[o]+wcounts[o]
+        wcounts = jnp.bincount(
+            jnp.where(win_sorted, oo, D), length=D + 1
+        )
+        wstarts = jnp.cumsum(wcounts) - wcounts
+        worder = jnp.argsort(jnp.where(win_sorted, oo, D), stable=True)
+        idx = jnp.clip(
+            wstarts[:D, None] + jnp.arange(cap_w, dtype=wstarts.dtype)[None, :],
+            0, oo.shape[0] - 1,
+        )
+        lane_src = worder[idx]  # [D(owner), cap_w] winner lanes
+        in_row = jnp.arange(cap_w)[None, :] < wcounts[:D, None]
+        ovf_w = wcounts[:D].max() > cap_w
+        spay = jnp.where(in_row, op[lane_src], 0)  # [D, cap_w]
+        pidx = (spay // K) % cap_f
+        slots = spay % K
+        parents = jax.tree.map(
+            lambda x: x[pidx.reshape(-1)], frontier
+        )
+        kids = self.kern.materialize(parents, slots.reshape(-1))
+        gp_send = jnp.where(in_row, dev * cap_f + pidx, -1)
+
+        def a2a(x):
+            # senders pre-mask dead lanes (jnp.where above); the exchange
+            # itself moves rows verbatim
+            return jax.lax.all_to_all(
+                x.reshape(D, cap_w, *x.shape[1:]), "d", 0, 0, tiled=True
+            ).reshape(D * cap_w, *x.shape[1:])
+
+        lane_r = a2a(in_row.astype(jnp.uint8).reshape(-1)).astype(bool)
+        gp_r = a2a(gp_send.reshape(-1))
+        sl_r = a2a(jnp.where(in_row, slots, 0).reshape(-1))
+        kids_r = jax.tree.map(a2a, kids)
+        # compact the received rows into this device's frontier block
+        cap_c = self.cap_x
+        comp = jnp.argsort(~lane_r, stable=True)
+        take = jnp.clip(jnp.arange(cap_c), 0, comp.shape[0] - 1)
+        src = comp[take]
+        lane = (jnp.arange(cap_c) < lane_r.sum()) & (
+            jnp.arange(cap_c) < comp.shape[0]
+        )
+        children = jax.tree.map(
+            lambda x: jnp.where(
+                lane.reshape((-1,) + (1,) * (x.ndim - 1)),
+                x[src], jnp.zeros_like(x[src]),
+            ),
+            kids_r,
+        )
+        gpidx = jnp.where(lane, gp_r[src], -1)
+        slots_c = jnp.where(lane, sl_r[src], -1)
+        n_new_local = lane.sum().astype(I64)
+        ovf_c = lane_r.sum() > cap_c
+        child_msum = (
+            self.fpr.msg_hash(children.msgs)
+            if self.canon == "expand"
+            else jnp.zeros((cap_c, 1, 1), jnp.uint32)
+        )
+        bad_local = jnp.zeros(cap_c, bool)
+        for _name, fn in self.inv_fns:
+            bad_local = bad_local | (
+                ~fn(self.cfg, children, self.kern.tables) & lane
+            )
+        inv_bad = jax.lax.psum(bad_local.sum().astype(I32), "d")
+        first_bad = jnp.where(
+            bad_local.any(), jnp.argmax(bad_local), -1
+        ).astype(I64)
+        return (children, child_msum, gpidx, slots_c, lane, n_new_local,
+                inv_bad, first_bad, ovf_w, ovf_c)
+
+    @functools.cached_property
+    def cap_w(self) -> int:
+        # per-(origin, owner) shipping rows.  Steady state puts
+        # n_new/D^2 winners on a pair; the healing case (a legacy
+        # parent-local frontier concentrated on one device) puts
+        # n_new/D on each of that origin's pairs — cap_x/2 covers both
+        # with the reactive grow as backstop.  _cap_w_boost grows cap_w
+        # alone (phase-2 retries must keep phase-1's cv/cp shapes).
+        return max(256, self.cap_x // 2) * getattr(self, "_cap_w_boost", 1)
+
     def _children_from(self, frontier, cap_f, dev, wpay, wlane):
         """Materialize chosen (payload) slots locally + invariants."""
         K = self.K
@@ -404,18 +520,19 @@ class ShardedChecker:
         # my candidate i (owner-grouped order) sits at (oo[i], rank[i])
         win_sorted = back[jnp.clip(oo, 0, D - 1), rr] & ok_lane
         n_new_total = jax.lax.psum(n_own_new.astype(I64), "d")
-        n_new_local = win_sorted.sum().astype(I64)
-        wpay, wlane = _compact(win_sorted, cap_x, op, fills=(I64(0),))
-        children, child_msum, gpidx, slots, inv_bad, first_bad = self._children_from(
-            frontier, cap_f, dev, wpay, wlane
+        (children, child_msum, gpidx, slots, _lane, n_new_local,
+         inv_bad, first_bad, ovf_w, ovf_c) = self._ship_winners_to_owners(
+            frontier, cap_f, dev, oo, op, win_sorted
         )
         return LevelOut(
             children, child_msum, upd,
             n_new_local[None], n_new_total,
             mult_slots.sum(), mult_slots,
-            gpidx, jnp.where(wlane, slots, -1),
+            gpidx, slots,
             inv_bad, first_bad[None], abort, abort_at[None],
-            jax.lax.psum(overflow_x.astype(I32), "d") > 0,
+            jax.lax.psum(
+                (overflow_x | ovf_w | ovf_c).astype(I32), "d"
+            ) > 0,
             jax.lax.psum(overflow_v.astype(I32), "d") > 0,
             jax.lax.pmax(counts[:D].sum().astype(I64), "d"),
         )
@@ -473,15 +590,16 @@ class ShardedChecker:
             verdict_recv, "d", 0, 0, tiled=True
         ).reshape(D, cap_r)
         win_sorted = back[jnp.clip(oo, 0, D - 1), rr] & ok_lane
-        n_new_local = win_sorted.sum().astype(I64)
-        n_new_total = jax.lax.psum(n_new_local, "d")
-        wpay, wlane = _compact(win_sorted, cap_x, op, fills=(I64(0),))
-        children, child_msum, gpidx, slots, inv_bad, first_bad = (
-            self._children_from(frontier, cap_f, dev, wpay, wlane)
+        n_new_total = jax.lax.psum(win_sorted.sum().astype(I64), "d")
+        (children, child_msum, gpidx, slots, _lane, n_new_local,
+         inv_bad, first_bad, ovf_w, ovf_c) = self._ship_winners_to_owners(
+            frontier, cap_f, dev, oo, op, win_sorted
         )
         return Phase2Out(
             children, child_msum, n_new_local[None], n_new_total,
-            gpidx, jnp.where(wlane, slots, -1), inv_bad, first_bad[None],
+            gpidx, slots, inv_bad, first_bad[None],
+            jax.lax.psum(ovf_w.astype(I32), "d") > 0,
+            jax.lax.psum(ovf_c.astype(I32), "d") > 0,
         )
 
     def _host_filter(self, rv, rf, rp):
@@ -542,6 +660,7 @@ class ShardedChecker:
                 out_specs=Phase2Out(
                     jax.tree.map(lambda _: P("d"), init_batch(self.cfg, 1)),
                     P("d"), P("d"), P(), P("d"), P("d"), P(), P("d"),
+                    P(), P(),
                 ),
                 check_vma=False,
             )
@@ -567,7 +686,7 @@ class ShardedChecker:
             grows += 1
             self.reactive_grows += 1
             self.cap_x *= 2
-            for k in ("level_phase1", "level_phase2", "cap_r"):
+            for k in ("level_phase1", "level_phase2", "cap_r", "cap_w"):
                 self.__dict__.pop(k, None)
         generated = p1.mult_slots.sum()
         common = dict(
@@ -586,7 +705,42 @@ class ShardedChecker:
             jnp.asarray(verdict.reshape(self.D * self.D, self.cap_r)),
             NamedSharding(self.mesh, P("d")),
         )
-        p2 = self.level_phase2(frontier, p1.cv, p1.cp, vr, n_f)
+        boosted = False
+        while True:
+            p2 = self.level_phase2(frontier, p1.cv, p1.cp, vr, n_f)
+            if not (bool(p2.ovf_w) or bool(p2.ovf_c)):
+                break
+            if grows >= 8:
+                raise RuntimeError(
+                    f"shipping overflow (cap_w={self.cap_w}, "
+                    f"cap_x={self.cap_x})"
+                )
+            grows += 1
+            self.reactive_grows += 1
+            if bool(p2.ovf_c):
+                # an owner received more new states than its cap_x
+                # frontier block: growing cap_w cannot help — grow cap_x
+                # and redo the WHOLE level (phase-1 shapes change)
+                self.cap_x *= 2
+                for k in ("level_phase1", "level_phase2", "cap_r",
+                          "cap_w"):
+                    self.__dict__.pop(k, None)
+                return self._hosted_level(frontier, msum, n_f)
+            # cap_w rows overflowed (healing a concentrated legacy
+            # frontier): grow cap_w ALONE and redo phase 2 — phase-1's
+            # cv/cp shapes must stay valid, so cap_x is not touched
+            boosted = True
+            self._cap_w_boost = getattr(self, "_cap_w_boost", 1) * 2
+            for k in ("level_phase2", "cap_w"):
+                self.__dict__.pop(k, None)
+        if boosted:
+            # the boost exists to absorb a one-time concentrated layout;
+            # after this level the frontier is owner-balanced, so drop it
+            # (one recompile next level beats shipping D x boosted rows
+            # of full states every level for the rest of the run)
+            self._cap_w_boost = 1
+            for k in ("level_phase2", "cap_w"):
+                self.__dict__.pop(k, None)
         n2 = int(np.asarray(p2.n_new_total))
         if n2 != n_new:
             raise RuntimeError(
@@ -798,6 +952,69 @@ class ShardedChecker:
                 f"mdelta replay rebuilt {len(fps)} distinct fingerprints "
                 f"for {distinct} recorded states — corrupt or mixed log"
             )
+        # Rebalance the resumed frontier by OWNER (fp % D).  Chains
+        # written before the owner-shipping exchange (rounds 2-4) carry
+        # the whole frontier on device 0 (n_local = [N, 0, ...]); left
+        # as-is, the first resumed level would need a ~D-times-larger
+        # cap_x for one level before the new exchange heals the layout.
+        # The relabel permutes rows host-side and permutes the LAST
+        # trace record identically, so slot-chain replay stays exact
+        # (earlier records reference their own levels' layouts, which
+        # are untouched).
+        if trace_levels and D > 1:
+            cap_cr = frontier.voted_for.shape[0] // D
+            fvh = np.asarray(fv.astype(U64))
+            validh = np.asarray(valid)
+            own = np.where(
+                validh, (fvh % np.uint64(D)).astype(np.int64), D
+            )
+            order = np.argsort(own, kind="stable")
+            counts_o = np.bincount(own, minlength=D + 1)[:D]
+            if counts_o.max() > cap_cr:
+                raise ValueError(
+                    f"owner rebalance needs {counts_o.max()} rows/device "
+                    f"but the replayed frontier block is {cap_cr}"
+                )
+            starts_o = np.cumsum(counts_o) - counts_o
+            perm = np.full(D * cap_cr, -1, np.int64)
+            for o in range(D):
+                seg = order[starts_o[o] : starts_o[o] + counts_o[o]]
+                perm[o * cap_cr : o * cap_cr + counts_o[o]] = seg
+            lane = perm >= 0
+            safe = np.clip(perm, 0, None)
+            frontier = jax.tree.map(
+                lambda x: jnp.where(
+                    jnp.asarray(lane).reshape(
+                        (-1,) + (1,) * (x.ndim - 1)
+                    ),
+                    x[jnp.asarray(safe)], jnp.zeros_like(x),
+                ),
+                frontier,
+            )
+            gpidx_l, slots_l = trace_levels[-1]
+            gpidx_n = np.where(lane, gpidx_l[safe], -1)
+            slots_n = np.where(lane, slots_l[safe], 0)
+            trace_levels[-1] = (gpidx_n, slots_n)
+            n_local = counts_o.astype(np.int64)
+            # Persist the normalized layout: records appended after this
+            # resume reference the REBALANCED level-d row positions, so
+            # the on-disk level-d record must describe them or the next
+            # full replay gathers wrong parents and dies as "corrupt or
+            # mixed log".  Row order + n_local change; the record's pidx
+            # values (indices into level d-1) are untouched.
+            z_last = np.load(files[-1])
+            validn = gpidx_n >= 0
+            slot_dt = z_last["slot"].dtype
+            tmp = files[-1] + ".tmp.npz"  # np.savez appends .npz itself
+            np.savez(
+                tmp,
+                pidx=gpidx_n[validn].astype(np.uint32),
+                slot=slots_n[validn].astype(slot_dt),
+                n_local=n_local,
+                mult=z_last["mult"],
+                meta=z_last["meta"],
+            )
+            os.replace(tmp, files[-1])
         if self.host_stores is not None:
             # the replay rebuilds the EXTERNAL stores: clear first (they
             # may hold pre-crash inserts, including a partially-completed
@@ -1046,7 +1263,7 @@ class ShardedChecker:
                 )
                 self.cap_x = want_x
                 for k in ("level_step", "level_phase1", "level_phase2",
-                          "cap_r"):
+                          "cap_r", "cap_w"):
                     self.__dict__.pop(k, None)
             if self.host_stores is None and self.exchange == "all_to_all":
                 # reactive trigger is distinct > D*vcap//2; stay under it
@@ -1105,8 +1322,8 @@ class ShardedChecker:
                         # candidate compaction / routing lanes overflowed:
                         # grow cap_x (recompiles the level step — rare)
                         self.cap_x *= 2
-                        self.__dict__.pop("level_step", None)
-                        self.__dict__.pop("cap_r", None)
+                        for k in ("level_step", "cap_r", "cap_w"):
+                            self.__dict__.pop(k, None)
             if bool(out.abort):
                 # locate the aborting parent (a current-frontier state) and
                 # replay its slot chain, exactly like the single-device path
