@@ -251,7 +251,13 @@ def main():
         # 15.75G).  Largest pow2 <= 8192 * 696 / K, clamped [1024, 8192].
         budget = max(1, 8192 * 696 // kern_K)
         chunk = max(1024, min(8192, 1 << (budget.bit_length() - 1)))
-    gold_depth = int(os.environ.get("BENCH_GOLD_DEPTH", "12"))
+    # The oracle gold prefix is a secondary parity anchor (the primary is
+    # cpubase's per-level counts to native_depth); its default depth must
+    # scale down with S — the pure-Python S! fold makes depth 12 at S=5
+    # a ~45-min CPU stall before the chip does any work (measured this
+    # round), while depth 9 keeps the same gate r3 shipped in ~1 min.
+    default_gold = {3: 12, 5: 9}.get(cfg.S, 7)
+    gold_depth = int(os.environ.get("BENCH_GOLD_DEPTH", str(default_gold)))
     if max_depth is not None:
         gold_depth = min(gold_depth, max_depth)
 

@@ -52,15 +52,20 @@ for s in "${steps[@]}"; do
     s3big) # bigger chunk variant
       run_bench docs/BENCH_S3_c16k_r05.json BENCH_CHUNK=16384 ;;
     s5)    # scale config 3 (warm steady-state — run s5 twice; the
-           # second run reads the persistent compile cache)
-      run_bench docs/BENCH_S5_r05.json BENCH_SERVERS=5 BENCH_MAX_DEPTH=16 ;;
+           # second run reads the persistent compile cache).  Gold depth 9
+           # as in r3: the Python oracle's S! fold makes depth 12 a ~45-min
+           # CPU stall at S=5; parity is still gated on cpubase's per-level
+           # counts to depth 16.
+      run_bench docs/BENCH_S5_r05.json BENCH_SERVERS=5 BENCH_MAX_DEPTH=16 \
+        BENCH_GOLD_DEPTH=9 ;;
     s7)    # scale config 5 (depth 9 — deeper than r2's 8 for a warmer
            # rate), with orbit pruning: color-discrete states skip the
            # P=5040 fold (counts unchanged — the parity gate still holds)
       run_bench docs/BENCH_S7_r05.json BENCH_SERVERS=7 BENCH_MAX_DEPTH=9 \
-        TLA_RAFT_ORBIT=1 ;;
+        BENCH_GOLD_DEPTH=7 TLA_RAFT_ORBIT=1 ;;
     s7base) # same without orbit pruning (A/B the fold cost)
-      run_bench docs/BENCH_S7_BASE_r05.json BENCH_SERVERS=7 BENCH_MAX_DEPTH=9 ;;
+      run_bench docs/BENCH_S7_BASE_r05.json BENCH_SERVERS=7 BENCH_MAX_DEPTH=9 \
+        BENCH_GOLD_DEPTH=7 ;;
     sweep) # deep-sweep continuation: level 29+ under host paging
       scripts/run_sweep.sh || fail=1 ;;
   esac

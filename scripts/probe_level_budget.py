@@ -1,0 +1,101 @@
+"""Full wall-clock budget of every BFS level, by stage, on the real engine.
+
+probe_span_stages.py measured the kernels in isolation; this probe runs
+the actual ``JaxChecker.run`` to a target depth and attributes each
+level's wall time to its stages by wrapping the engine's entry points
+with block_until_ready fences:
+
+  span        — _expand_span calls (the G-chunk scanned expand)
+  chunk       — per-chunk tail _expand_chunk calls
+  group_filt  — _group_filter (visited filter + compaction per group)
+  level_dedup — _level_dedup (level-wide lexsort + visited filter)
+  mat_grow    — _materialize_grow (survivor children -> new frontier)
+  merge       — _merge_sorted (visited store insert)
+  other       — everything else in the level (host fetches, numpy, sync)
+
+The fences serialize stages that the async queue would otherwise
+overlap; with sync_every=1 on the tunneled backend the run is already
+nearly serial, so the distortion is small — and the point is attribution,
+not absolute rate.
+
+Usage: PYTHONPATH=/root/.axon_site:. python scripts/probe_level_budget.py [depth] [chunk]
+"""
+
+import sys
+import time
+from collections import defaultdict
+
+depth = int(sys.argv[1]) if len(sys.argv) > 1 else 19
+chunk = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
+
+from tla_raft_tpu.platform import setup_jax
+
+jax = setup_jax()
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.engine import bfs
+
+cfg = load_raft_config("/root/reference/Raft.cfg")
+print("backend:", jax.default_backend(), "chunk:", chunk, "to depth", depth)
+
+chk = JaxChecker(cfg, chunk=chunk, progress=lambda s: progress(s))
+acc = defaultdict(float)
+level_t0 = [time.monotonic()]
+
+
+def fence(label, fn):
+    def wrapped(*a, **k):
+        t0 = time.monotonic()
+        out = fn(*a, **k)
+        jax.block_until_ready(out)
+        acc[label] += time.monotonic() - t0
+        return out
+
+    return wrapped
+
+
+chk._expand_span = fence("span", chk._expand_span)
+chk._expand_chunk = fence("chunk", chk._expand_chunk)
+chk._materialize_grow = fence("mat_grow", chk._materialize_grow)
+bfs._group_filter = fence("group_filt", bfs._group_filter)
+bfs._level_dedup = fence("level_dedup", bfs._level_dedup)
+bfs._merge_sorted = fence("merge", bfs._merge_sorted)
+
+rows = []
+
+
+def progress(s):
+    now = time.monotonic()
+    lvl_wall = now - level_t0[0]
+    level_t0[0] = now
+    staged = dict(acc)
+    acc.clear()
+    other = lvl_wall - sum(staged.values())
+    rows.append((s["level"], s["frontier"], lvl_wall, staged, other))
+    parts = " ".join(f"{k}={v:.1f}" for k, v in sorted(staged.items()))
+    print(
+        f"level {s['level']:>2} new={s['frontier']:>9,} wall={lvl_wall:7.1f}s "
+        f"{parts} other={other:.1f}",
+        flush=True,
+    )
+
+
+t0 = time.monotonic()
+res = chk.run(max_depth=depth)
+wall = time.monotonic() - t0
+print(f"\ntotal: distinct={res.distinct:,} wall={wall:.1f}s ok={res.ok}")
+print(f"cap_x={chk.cap_x} cap_g={chk.cap_g} K={chk.K} G={chk.G} "
+      f"sync_every={chk.sync_every}")
+
+deep = [r for r in rows if r[0] >= depth - 2]
+tot = defaultdict(float)
+wall_d = 0.0
+for _, _, w, staged, other in deep:
+    wall_d += w
+    for k, v in staged.items():
+        tot[k] += v
+    tot["other"] += other
+print(f"\nlast {len(deep)} levels ({wall_d:.1f}s):")
+for k, v in sorted(tot.items(), key=lambda kv: -kv[1]):
+    print(f"  {k:<12} {v:8.1f}s  {100 * v / max(wall_d, 1e-9):5.1f}%")

@@ -1,0 +1,126 @@
+"""Decompose _mat_slice's 420 ms/slice (probe_level_budget: mat_grow is
+41.6% of deep-level wall) into its stages at the real slice shape.
+
+Stages: parent gather from a deep frontier, _ids_to_msgs inflate (the
+[n, cap_m, W] one-hot), kern.materialize, _msgs_to_ids deflate (the
+[n, M] top_k(cap_m) — suspected dominator), invariant scan, and the
+fused _mat_slice for reference.  Also times _group_filter at its real
+[G*cap_x] lane count (probe_level_budget: 2.3 s/group) and a sort-prefix
+alternative to its top_k.
+
+Usage: PYTHONPATH=/root/.axon_site:. python scripts/probe_mat_stages.py [depth] [chunk]
+"""
+
+import sys
+import time
+
+depth = int(sys.argv[1]) if len(sys.argv) > 1 else 14
+chunk = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
+
+from tla_raft_tpu.platform import setup_jax
+
+jax = setup_jax()
+
+import jax.numpy as jnp
+import numpy as np
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.engine.bfs import I64, SENT, U64, _group_filter
+
+cfg = load_raft_config("/root/reference/Raft.cfg")
+print("backend:", jax.default_backend(), "chunk:", chunk, "depth:", depth)
+
+chk = JaxChecker(cfg, chunk=chunk)
+state = {}
+orig = JaxChecker._expand_level
+
+
+def cap_expand(self, frontier, n_f, visited, **kw):
+    state.update(frontier=frontier, n_f=n_f, visited=visited)
+    return orig(self, frontier, n_f, visited, **kw)
+
+
+JaxChecker._expand_level = cap_expand
+res = chk.run(max_depth=depth)
+JaxChecker._expand_level = orig
+frontier, n_f, visited = state["frontier"], state["n_f"], state["visited"]
+K, cap_m = chk.K, chk.cap_m
+sl = 4 * chunk
+print(f"frontier n_f={n_f} K={K} cap_m={cap_m} sl={sl} cap_x={chk.cap_x}")
+
+# a realistic survivor payload slice: rerun one level's dedup output
+n_new, new_fps, new_payload = chk._expand_level(frontier, n_f, visited)[:3]
+print(f"level n_new={n_new}")
+pay = jax.lax.dynamic_slice_in_dim(new_payload, 0, sl)
+n_valid = jnp.asarray(min(sl, n_new), I64)
+
+
+def timeit(label, fn, n=5):
+    jax.block_until_ready(fn())
+    ts = []
+    for _ in range(n):
+        t0 = time.monotonic()
+        jax.block_until_ready(fn())
+        ts.append(time.monotonic() - t0)
+    dt = sorted(ts)[len(ts) // 2]
+    print(f"  {label:<40} {dt * 1e3:9.1f} ms")
+    return dt
+
+
+pidx = (pay // K).astype(jnp.int32)
+slots = pay % K
+gather = jax.jit(
+    lambda fr, pi: jax.tree.map(lambda x: x[jnp.clip(pi, 0, None)], fr)
+)
+parents_c = gather(frontier, pidx)
+inflate = jax.jit(chk._inflate)
+parents = inflate(parents_c)
+mat = jax.jit(lambda p, s: chk.kern.materialize(p, s))
+children = mat(parents, slots)
+deflate_ids = jax.jit(lambda m: chk._msgs_to_ids(m))
+inv = jax.jit(lambda c, nv: chk._inv_scan_impl(c, nv))
+
+print("stages (isolated, slice rows = %d):" % sl)
+timeit("parent gather", lambda: gather(frontier, pidx))
+timeit("inflate (_ids_to_msgs one-hot)", lambda: inflate(parents_c))
+timeit("kern.materialize", lambda: mat(parents, slots))
+timeit(f"deflate top_k(M->{cap_m})", lambda: deflate_ids(children.msgs))
+timeit("invariant scan", lambda: inv(children, n_valid))
+timeit("fused _mat_slice", lambda: chk._mat_slice(frontier, pay, n_valid))
+
+# group filter at its real lane count vs a sort-prefix alternative
+lanes = chk.G * chk.cap_x
+cv_np = np.arange(lanes, dtype=np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+cv_np[::3] = np.uint64(0xFFFFFFFFFFFFFFFF)
+cv = jnp.asarray(cv_np)
+cf = cv ^ U64(0xABCDEF)
+cp = jnp.arange(lanes, dtype=I64)
+jax.block_until_ready((cv, cf, cp))
+print(f"group filter ({lanes} lanes, cap_g={chk.cap_g}):")
+timeit("_group_filter (top_k)", lambda: _group_filter(cv, cf, cp, visited, chk.cap_g))
+
+
+@jax.jit
+def group_filter_sort(cv, cf, cp, visited, cap_g: int):
+    pos = jnp.searchsorted(visited, cv)
+    hit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == cv
+    keep = (cv != SENT) & ~hit
+    n = keep.sum()
+    # pack keep+lane index into one sortable key; stable prefix = kept lanes
+    key = jnp.where(keep, cp, jnp.iinfo(jnp.int64).max)
+    order = jnp.argsort(key)[: chk.cap_g]
+    lane = jnp.arange(chk.cap_g) < n
+    return (
+        jnp.where(lane, cv[order], SENT),
+        jnp.where(lane, cf[order], SENT),
+        jnp.where(lane, cp[order], -1),
+        n > chk.cap_g,
+    )
+
+
+timeit("group filter (argsort prefix)", lambda: group_filter_sort(cv, cf, cp, visited, chk.cap_g))
+
+# searchsorted alone (the visited probe part)
+ss = jax.jit(lambda v, c: jnp.searchsorted(v, c))
+timeit("searchsorted(visited) alone", lambda: ss(visited, cv))

@@ -1,0 +1,154 @@
+"""Isolate the cost of each stage INSIDE the expand-span program.
+
+The round-5 on-chip s3 run showed deep levels cost ~6.7 s per 16-chunk
+span with only ~2 dispatches + 1 scalar sync per span — i.e. the span is
+now device-compute-bound, not dispatch-bound (docs/PERF.md round 4
+predicted the opposite).  This probe times the span's constituent
+kernels in isolation on the current backend, at the real deep-level
+shapes (chunk x K guard lanes, cap_x compaction, 6-perm fingerprint
+fold), so the next optimization targets the measured bottleneck instead
+of the assumed one.
+
+Stages timed (all block_until_ready-fenced, median of 3):
+  guards      — kern.expand_guards on one inflated chunk
+  compact     — _compact_payloads: top_k over chunk*K lanes -> cap_x
+  mat+fp      — materialize + P-folded fingerprints of cap_x candidates
+  chunk       — the fused _expand_chunk program (all of the above)
+  span        — _expand_span: G chunks in one lax.scan program
+  group_filt  — _group_filter: searchsorted + top_k over G*cap_x lanes
+  level_dedup — _level_dedup at the real level lane count
+
+Usage: PYTHONPATH=. python scripts/probe_span_stages.py [depth] [chunk]
+(defaults depth 15, chunk 8192 — ~170k-parent frontier on Raft.cfg).
+"""
+
+import sys
+import time
+
+depth = int(sys.argv[1]) if len(sys.argv) > 1 else 15
+chunk = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
+
+from tla_raft_tpu.platform import setup_jax
+
+jax = setup_jax()
+
+import jax.numpy as jnp
+import numpy as np
+
+from tla_raft_tpu.cfgparse import load_raft_config
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.engine.bfs import (
+    BIG, I64, SENT, U64, _compact_payloads, _group_filter, _level_dedup,
+)
+
+cfg = load_raft_config("/root/reference/Raft.cfg")
+print("backend:", jax.default_backend(), "chunk:", chunk, "depth:", depth)
+
+chk = JaxChecker(cfg, chunk=chunk)
+state = {}
+orig = JaxChecker._expand_level
+
+
+def cap_expand(self, frontier, n_f, visited, **kw):
+    state.update(frontier=frontier, n_f=n_f, visited=visited)
+    return orig(self, frontier, n_f, visited, **kw)
+
+
+JaxChecker._expand_level = cap_expand
+t0 = time.monotonic()
+res = chk.run(max_depth=depth)
+JaxChecker._expand_level = orig
+print(
+    f"run to depth {depth}: frontier {res.level_sizes[-1]}, "
+    f"distinct {res.distinct}, {time.monotonic() - t0:.1f}s"
+)
+frontier, n_f, visited = state["frontier"], state["n_f"], state["visited"]
+K, cap_x, G = chk.K, chk.cap_x, chk.G
+print(
+    f"captured pre-final-level frontier: n_f={n_f} "
+    f"(K={K} cap_x={cap_x} G={G} visited_cap={visited.shape[0]})"
+)
+
+
+def timeit(label, fn, n=3):
+    jax.block_until_ready(fn())  # warm/compile
+    ts = []
+    for _ in range(n):
+        t0 = time.monotonic()
+        jax.block_until_ready(fn())
+        ts.append(time.monotonic() - t0)
+    dt = sorted(ts)[len(ts) // 2]
+    print(f"  {label:<36} {dt * 1e3:9.1f} ms")
+    return dt
+
+
+n_f_dev = jnp.asarray(n_f, I64)
+zero = jnp.asarray(0, I64)
+
+part_f = jax.tree.map(
+    lambda x: jax.lax.dynamic_slice_in_dim(x, 0, chunk), frontier
+)
+
+
+@jax.jit
+def guards_only(pf):
+    part = chk._inflate(pf)
+    valid, mult, ab = chk.kern.expand_guards(part)
+    return valid, mult, ab
+
+
+valid, _mult, _ab = guards_only(part_f)
+payload = jnp.arange(chunk * K, dtype=I64)
+
+
+@jax.jit
+def compact_only(v, pay):
+    return _compact_payloads(v.ravel(), pay, cap_x)
+
+
+cp_raw, lane, _ovf = compact_only(valid, payload)
+
+
+@jax.jit
+def mat_fp_only(pf, cp, ln):
+    part = chk._inflate(pf)
+    lidx = jnp.clip(cp // K, 0, chunk - 1).astype(jnp.int32)
+    slots = cp % K
+    parents = jax.tree.map(lambda x: x[lidx], part)
+    children = chk.kern.materialize(parents, slots)
+    fv, ff, _ = chk.fpr.state_fingerprints(children)
+    return jnp.where(ln, fv.astype(U64), SENT), jnp.where(ln, ff.astype(U64), SENT)
+
+
+print("stages (isolated):")
+t_g = timeit("guards (chunk*K lanes)", lambda: guards_only(part_f))
+t_c = timeit(f"compact top_k({chunk * K}->{cap_x})", lambda: compact_only(valid, payload))
+t_m = timeit(f"materialize+fp ({cap_x} cand)", lambda: mat_fp_only(part_f, cp_raw, lane))
+t_k = timeit("fused _expand_chunk", lambda: chk._expand_chunk(part_f, zero, n_f_dev))
+
+n_chunks = -(-n_f // chunk)
+if n_chunks >= G:
+    t_s = timeit(
+        f"_expand_span ({G} chunks)",
+        lambda: chk._expand_span(frontier, zero, zero, n_f_dev),
+        n=1,
+    )
+    cvs, cfs, cps, *_ = chk._expand_span(frontier, zero, zero, n_f_dev)
+    gv_in = (cvs.reshape(-1), cfs.reshape(-1), cps.reshape(-1))
+    jax.block_until_ready(gv_in)
+    t_f = timeit(
+        f"_group_filter ({G * cap_x}->{chk.cap_g})",
+        lambda: _group_filter(*gv_in, visited, chk.cap_g),
+    )
+    per_span = t_s + t_f
+    spans = n_f / (G * chunk)
+    print(
+        f"  => span+filter {per_span:.2f}s x {spans:.1f} spans "
+        f"= {per_span * spans:.1f}s expand wall for this level"
+    )
+
+n_lanes = 1 << (max(G * cap_x, 2) - 1).bit_length()
+lv = jnp.full((n_lanes,), SENT, U64)
+lf = jnp.full((n_lanes,), SENT, U64)
+lp = jnp.full((n_lanes,), -1, I64)
+timeit(f"_level_dedup ({n_lanes} lanes)", lambda: _level_dedup(lv, lf, lp, visited))

@@ -1,0 +1,86 @@
+"""_ids_insert (sorted-insert deflate) vs _msgs_to_ids (top_k deflate).
+
+The materialize pass builds child msg-id lists by inserting the action's
+sent ids into the parent's sorted list (engine/bfs.py _ids_insert); the
+reference implementation recovers them from the packed bitmask with a
+top_k over the whole universe (_msgs_to_ids).  The two must be
+bit-identical — same set, ascending order, -1 padding — including
+already-present re-sends (set-union semantics, Raft.tla:43-45) and
+overflow flagging.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.engine.bfs import I64
+
+
+@pytest.fixture(scope="module")
+def chk():
+    return JaxChecker(
+        RaftConfig(n_servers=3, n_vals=1, max_election=1, max_restart=0),
+        chunk=16,
+    )
+
+
+def _pack_rows(chk, rows_bits):
+    W = chk.uni_words
+    out = np.zeros((len(rows_bits), W), np.uint32)
+    for i, ids in enumerate(rows_bits):
+        for mid in ids:
+            out[i, mid >> 5] |= np.uint32(1) << np.uint32(mid & 31)
+    return jnp.asarray(out)
+
+
+def test_ids_insert_matches_topk_deflate(chk):
+    M = chk.kern.uni.M
+    A = chk.kern.A
+    rng = np.random.default_rng(7)
+    n = 64
+    parent_sets, adds = [], []
+    for i in range(n):
+        k = int(rng.integers(0, min(chk.cap_m - A, M, 40)))
+        parent_sets.append(sorted(rng.choice(M, size=k, replace=False)))
+        row = []
+        for _ in range(A):
+            r = rng.random()
+            if r < 0.3:
+                row.append(-1)  # padded lane
+            elif r < 0.5 and parent_sets[-1]:
+                row.append(int(rng.choice(parent_sets[-1])))  # re-send
+            else:
+                row.append(int(rng.integers(0, M)))  # fresh (maybe dup)
+        adds.append(row)
+
+    parent_msgs = _pack_rows(chk, parent_sets)
+    parent_ids, ovf0 = chk._msgs_to_ids(parent_msgs)
+    assert not bool(np.asarray(ovf0).any())
+
+    got_ids, got_ovf = chk._ids_insert(parent_ids, jnp.asarray(adds, jnp.int32))
+
+    child_sets = [
+        sorted(set(p) | {a for a in row if a >= 0})
+        for p, row in zip(parent_sets, adds)
+    ]
+    want_ids, want_ovf = chk._msgs_to_ids(_pack_rows(chk, child_sets))
+    np.testing.assert_array_equal(np.asarray(got_ids), np.asarray(want_ids))
+    assert not bool(np.asarray(got_ovf).any())
+    assert not bool(np.asarray(want_ovf).any())
+
+
+def test_ids_insert_overflow_flag(chk):
+    """Inserting into a full id list must flag, not silently drop."""
+    M = chk.kern.uni.M
+    A = chk.kern.A
+    cap = chk.cap_m
+    full = list(range(1, cap + 1))  # cap_m ids, all lanes used
+    parent_ids, _ = chk._msgs_to_ids(_pack_rows(chk, [full, full]))
+    adds = jnp.asarray(
+        [[0] + [-1] * (A - 1), [full[0]] + [-1] * (A - 1)], jnp.int32
+    )
+    _, ovf = chk._ids_insert(parent_ids, adds)
+    assert bool(np.asarray(ovf)[0])  # fresh id, no room -> overflow
+    assert not bool(np.asarray(ovf)[1])  # re-send of a present id -> fine

@@ -552,6 +552,39 @@ class JaxChecker:
         ovf = bits.sum(-1, dtype=I32) > self.cap_m
         return ids.astype(self.id_dtype), ovf
 
+    def _ids_insert(self, ids: jnp.ndarray, added: jnp.ndarray):
+        """Child msg-id lists by sorted insertion of the sent ids.
+
+        ids i32ish[n, cap_m]: the PARENTS' ascending -1-padded id lists;
+        added i32[n, A]: the ids the materialized action sent (-1 pads).
+        Returns (child_ids [n, cap_m], overflow bool[n]) — bit-identical
+        to ``_msgs_to_ids(children.msgs)`` (same set, ascending, -1-
+        padded) but in A tiny elementwise passes instead of a top_k over
+        the M-wide universe per row (182.9 ms vs ~2 ms per 32k-row slice
+        on the v5e — the measured dominator of the materialize pass).
+        Already-present ids re-sent by guard-free actions (e.g.
+        FollowerAcceptEntry, Raft.tla:275-300 — set union semantics,
+        Raft.tla:43-45) are skipped like the bitmask OR they mirror.
+        """
+        M = self.kern.uni.M
+        cap_m = ids.shape[1]
+        pos_iota = jnp.arange(cap_m, dtype=I32)[None, :]
+        cur = jnp.where(ids < 0, I32(M), ids.astype(I32))  # pads sort last
+        ovf = jnp.zeros(ids.shape[0], bool)
+        for a in range(added.shape[1]):
+            aid = added[:, a].astype(I32)[:, None]  # [n, 1]
+            live = (aid >= 0) & ~jnp.any(cur == aid, axis=1, keepdims=True)
+            pos = jnp.sum(cur < aid, axis=1, dtype=I32)[:, None]
+            shifted = jnp.concatenate([cur[:, :1], cur[:, :-1]], axis=1)
+            ins = jnp.where(
+                pos_iota < pos, cur,
+                jnp.where(pos_iota == pos, aid, shifted),
+            )
+            ovf = ovf | (live[:, 0] & (cur[:, -1] < M))
+            cur = jnp.where(live, ins, cur)
+        child = jnp.where(cur >= M, I32(-1), cur).astype(self.id_dtype)
+        return child, ovf
+
     def _inflate(self, fr: Frontier) -> RaftState:
         """Frontier chunk -> full RaftState with the packed bitmask."""
         core = {f: getattr(fr, f) for f in _CORE_FIELDS}
@@ -577,8 +610,12 @@ class JaxChecker:
         slots = pay % K
         parents_c = jax.tree.map(lambda x: x[jnp.clip(pidx, 0, None)], frontier)
         parents = self._inflate(parents_c)
-        children = self.kern.materialize(parents, slots)
-        child_f, ovf_rows = self._deflate(children)
+        children, added = self.kern.materialize_added(parents, slots)
+        child_ids, ovf_rows = self._ids_insert(parents_c.msg_ids, added)
+        child_f = Frontier(
+            msg_ids=child_ids,
+            **{f: getattr(children, f) for f in _CORE_FIELDS},
+        )
         in_range = jnp.arange(ovf_rows.shape[0], dtype=I64) < n_valid
         bad_at = self._inv_scan_impl(children, n_valid)
         return child_f, bad_at, (ovf_rows & in_range).any()
@@ -605,8 +642,12 @@ class JaxChecker:
             seg_a, seg_b,
         )
         parents = self._inflate(parents_c)
-        children = self.kern.materialize(parents, slots)
-        child_f, ovf_rows = self._deflate(children)
+        children, added = self.kern.materialize_added(parents, slots)
+        child_ids, ovf_rows = self._ids_insert(parents_c.msg_ids, added)
+        child_f = Frontier(
+            msg_ids=child_ids,
+            **{f: getattr(children, f) for f in _CORE_FIELDS},
+        )
         in_range = jnp.arange(ovf_rows.shape[0], dtype=I64) < n_valid
         bad_at = self._inv_scan_impl(children, n_valid)
         return child_f, bad_at, (ovf_rows & in_range).any()
@@ -836,7 +877,11 @@ class JaxChecker:
         _materialize_segs / _materialize_fallback_segs — whose transients
         are segment-bounded.)
         """
-        sl = min(4 * self.chunk, new_payload.shape[0])
+        # 8x-chunk slices: with the sorted-insert deflate the per-slice
+        # compute is light enough that slice count (dispatch + drain
+        # round-trips on the tunneled backend) is the next cost; 64k rows
+        # x ~240 B keeps the in-flight working set at ~16 MB/slice
+        sl = min(8 * self.chunk, new_payload.shape[0])
         n_slices = -(-n_new // sl)
         child_parts, bad_ds, ovf_ds = [], [], []
         for si in range(n_slices):
@@ -1124,7 +1169,8 @@ class JaxChecker:
         cap_m (the sparse-frontier message-set width) grows ~1 per BFS
         level on the reference family; a fixed budget would make deep
         sweeps die hours in (VERDICT round 2, weak #6).  Overflow is
-        detected per slice by ``_msgs_to_ids``; the payloads are already
+        detected per slice by ``_ids_insert`` (an action's sent id finds
+        the parent's id lanes full); the payloads are already
         known, so growing the width, widening the (parent) frontier's id
         lanes and re-materializing the level is pure re-computation —
         the same recovery shape as the cap_x growth redo.  EXCEPT on the
@@ -1487,8 +1533,14 @@ class JaxChecker:
         # device visited table (deep levels are <=50% fresh; it does NO
         # intra-group dedup).  It stays off at small frontiers (the
         # level-wide sort is tiny and new/parent ratios up to ~2.5 would
-        # overflow cap_g).
-        grouping = n_chunks > 4 * G
+        # overflow cap_g) — and the threshold matters for throughput: the
+        # filter's searchsorted against the visited store costs ~0.7 s
+        # per 1M-lane group on the v5e (binary search = 22 rounds of
+        # random gathers; measured round 5), so levels small enough for
+        # the level-wide sort to fit run ~25% faster without grouping.
+        # 256 chunks * cap_x 64k * 24 B = ~1.2 GB of sort operands —
+        # comfortably inside one chip's HBM next to frontier + visited.
+        grouping = n_chunks > 16 * G
 
         def flush_group():
             while len(cvs) < G:  # pad the group to its fixed width
@@ -1586,9 +1638,11 @@ class JaxChecker:
             lfs = sfs + cfs
             lps = sps + cps
             n_lanes = (len(svs) * G + len(cvs)) * self.cap_x
-        # pad the level-dedup input to a power-of-two lane count so its
-        # sort program compiles O(log) times per run, not once per level
-        pad = _pow2(n_lanes) - n_lanes
+        # pad the level-dedup input to a half-step-quantized lane count
+        # ({2^k, 3*2^(k-1)}) so its sort program compiles O(log) times per
+        # run, not once per level — and a just-over-pow2 level (the common
+        # case after a 1.5x cap_x growth) pays a 12% pad, not 95%
+        pad = _cap_steps(max(n_lanes, 1)) - n_lanes
         if pad:
             lvs.append(jnp.full((pad,), SENT, U64))
             lfs.append(jnp.full((pad,), SENT, U64))
@@ -2011,7 +2065,12 @@ class JaxChecker:
                 # chunk program, so re-jit; cap_g is a static jit arg and
                 # retraces on its own.
                 if overflow:
-                    self.cap_x *= 2
+                    # half-step growth ({2^k, 3*2^(k-1)}): a doubled cap_x
+                    # inflates every downstream lane count (group filter,
+                    # level sort) for the rest of the run, and the common
+                    # overflow is a mid-depth level firing ~5 lanes/parent
+                    # against a 4x-chunk budget — 1.5x absorbs it
+                    self.cap_x = _cap_steps(self.cap_x + 1)
                     self.cap_g = max(self.cap_g, self.G * self.cap_x // 2)
                     self._expand_chunk = jax.jit(self._expand_chunk_impl)
                     self._expand_span = jax.jit(self._expand_span_impl)

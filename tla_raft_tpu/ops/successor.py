@@ -293,6 +293,7 @@ class SuccessorKernel:
         self.expand_reference = jax.jit(self._expand)
         self.expand_guards = jax.jit(self._expand_guards)
         self.materialize = jax.jit(self._materialize)
+        self.materialize_added = jax.jit(self._materialize_added)
 
     def _expand_dense(self, st: RaftState, msum: jnp.ndarray) -> Expansion:
         valid, mult, fpv, fpf, abort = self.dense(st, msum)
@@ -652,7 +653,7 @@ class SuccessorKernel:
 
     # -- pass 2: materialize surviving slots ------------------------------
 
-    def _materialize_one(self, st: RaftState, slot: jnp.ndarray) -> RaftState:
+    def _materialize_one(self, st: RaftState, slot: jnp.ndarray):
         # slot -> (family, coords) via one-hot contraction over the K-row
         # constants (a per-lane gather would hit the slow-gather path)
         oh_slot = (jnp.arange(self.K) == slot).astype(I32)
@@ -675,7 +676,7 @@ class SuccessorKernel:
 
                 for a in range(self.A):
                     msgs = set_bit(msgs, added[a])
-                return child._replace(msgs=msgs)
+                return child._replace(msgs=msgs), added
 
             return branch
 
@@ -684,6 +685,15 @@ class SuccessorKernel:
 
     def _materialize(self, parents: RaftState, slots: jnp.ndarray) -> RaftState:
         """parents: leaves with leading dim G (already gathered); slots i32[G]."""
+        return jax.vmap(self._materialize_one)(parents, slots)[0]
+
+    def _materialize_added(self, parents: RaftState, slots: jnp.ndarray):
+        """As ``materialize``, but also returns the sent message ids
+        (``added`` i32[G, A], -1-padded) so callers holding the parents'
+        sparse msg-id lists can build the children's lists by sorted
+        insertion instead of recovering them from the packed bitmask with
+        a per-row top_k over the whole message universe (the measured
+        dominator of the materialize pass, docs/PERF.md round 5)."""
         return jax.vmap(self._materialize_one)(parents, slots)
 
 
