@@ -486,8 +486,6 @@ class JaxChecker:
         ]
         self._mat_slice = jax.jit(self._mat_slice_impl)
         self._mat_slice_seg = jax.jit(self._mat_slice_seg_impl)
-        self._expand_chunk = jax.jit(self._expand_chunk_impl)
-        self._expand_span = jax.jit(self._expand_span_impl)
         self._inv_scan = jax.jit(self._inv_scan_impl)
         # G-chunk span programs replace per-chunk dispatch at real chunk
         # sizes: each per-chunk round costs ~13 host->device dispatches
@@ -521,6 +519,27 @@ class JaxChecker:
         self.orbit = bool(int(env_orb)) if env_orb else False
         if self.orbit and canon != "late":
             raise ValueError("TLA_RAFT_ORBIT requires canon='late'")
+        self._jit_expand_programs()
+
+    def _jit_expand_programs(self):
+        """(Re-)jit the chunk expand programs (cap_x is baked in).
+
+        Orbit runs the chunk as TWO programs — guards/compact/materialize,
+        then fingerprints — because the fused variant (canonical-relabel
+        machinery + the exact-fold fallback on top of the expand) pushed
+        the S=7 compile past the tunnel's remote-compile window (the
+        round-5 s7 campaign step died mid-compile).  Split, each program
+        is no bigger than the non-orbit fused one, and at S=7 rates the
+        extra dispatch is noise.  Spans stay off under orbit for the same
+        reason (the scan multiplies program size by G).
+        """
+        self._expand_span = jax.jit(self._expand_span_impl)
+        if self.orbit:
+            self._expand_chunk_core = jax.jit(self._expand_chunk_core_impl)
+            self._orbit_fps = jax.jit(self._orbit_fps_impl)
+            self._expand_chunk = self._expand_chunk_split
+        else:
+            self._expand_chunk = jax.jit(self._expand_chunk_impl)
 
     # -- sparse <-> dense message-set conversion ---------------------------
 
@@ -669,11 +688,32 @@ class JaxChecker:
         part = self._inflate(part_f)
         cap = part.voted_for.shape[0]
         if self.canon == "late":
-            valid, mult, ab_state = self.kern.expand_guards(part)
+            # orbit always goes through the split two-program route
+            # (_expand_chunk_split); tracing the fused variant with the
+            # orbit machinery inlined is exactly the program that overran
+            # the tunnel's remote compile (see _jit_expand_programs)
+            assert not self.orbit, "orbit uses _expand_chunk_split"
+            (children, lane, cp_raw, mult_slots, abort_at,
+             overflow) = self._expand_chunk_core_late(part, start, n_f)
+            fv, ff, _msum = self.fpr.state_fingerprints(children)
+            cv = jnp.where(lane, fv.astype(U64), SENT)
+            cf = jnp.where(lane, ff.astype(U64), SENT)
+            cp = jnp.where(lane, cp_raw, -1)
         else:
             msum_part = self.fpr.msg_hash(part.msgs)
             exp = self.kern.expand(part, msum_part)
-            valid, mult, ab_state = exp.valid, exp.mult, exp.abort
+            valid, payload, mult_slots, abort_at = self._chunk_bookkeeping(
+                exp.valid, exp.mult, exp.abort, start, n_f, cap
+            )
+            fpv = jnp.where(valid, exp.fp_view, SENT).ravel()
+            fpf = jnp.where(valid, exp.fp_full, SENT).ravel()
+            cv, cf, cp, overflow = _chunk_compact(fpv, fpf, payload, self.cap_x)
+        return cv, cf, cp, mult_slots, abort_at, overflow
+
+    def _chunk_bookkeeping(self, valid, mult, ab_state, start, n_f, cap):
+        """Shared chunk accounting: in-range mask, global payload ids,
+        per-slot multiplicity totals, first-abort position."""
+        K = self.K
         in_range = (start + jnp.arange(cap, dtype=I64) < n_f)[:, None]
         valid = valid & in_range
         base = ((start + jnp.arange(cap, dtype=I64)) * K)[:, None]
@@ -683,27 +723,48 @@ class JaxChecker:
         abort_at = jnp.where(
             ab.any(), start + jnp.argmax(ab).astype(I64), BIG
         )
-        if self.canon == "late":
-            cp_raw, lane, overflow = _compact_payloads(
-                valid.ravel(), payload, self.cap_x
-            )
-            lidx = jnp.clip(cp_raw // K - start, 0, cap - 1).astype(I32)
-            slots = cp_raw % K
-            parents = jax.tree.map(lambda x: x[lidx], part)
-            children = self.kern.materialize(parents, slots)
-            if self.orbit:
-                fv, ff, nd_ovf = self._orbit_chunk_fps(children, lane)
-                overflow = overflow | nd_ovf
-            else:
-                fv, ff, _msum = self.fpr.state_fingerprints(children)
-            cv = jnp.where(lane, fv.astype(U64), SENT)
-            cf = jnp.where(lane, ff.astype(U64), SENT)
-            cp = jnp.where(lane, cp_raw, -1)
-        else:
-            fpv = jnp.where(valid, exp.fp_view, SENT).ravel()
-            fpf = jnp.where(valid, exp.fp_full, SENT).ravel()
-            cv, cf, cp, overflow = _chunk_compact(fpv, fpf, payload, self.cap_x)
-        return cv, cf, cp, mult_slots, abort_at, overflow
+        return valid, payload, mult_slots, abort_at
+
+    def _expand_chunk_core_late(self, part, start, n_f):
+        """canon='late' chunk body up to materialize — NO fingerprints.
+
+        ``part`` is the already-inflated chunk.  Shared by the fused
+        program and the orbit split path (see ``_jit_expand_programs``).
+        """
+        K = self.K
+        cap = part.voted_for.shape[0]
+        valid, mult, ab_state = self.kern.expand_guards(part)
+        valid, payload, mult_slots, abort_at = self._chunk_bookkeeping(
+            valid, mult, ab_state, start, n_f, cap
+        )
+        cp_raw, lane, overflow = _compact_payloads(
+            valid.ravel(), payload, self.cap_x
+        )
+        lidx = jnp.clip(cp_raw // K - start, 0, cap - 1).astype(I32)
+        slots = cp_raw % K
+        parents = jax.tree.map(lambda x: x[lidx], part)
+        children = self.kern.materialize(parents, slots)
+        return children, lane, cp_raw, mult_slots, abort_at, overflow
+
+    def _expand_chunk_core_impl(self, part_f: Frontier, start, n_f):
+        """Jit target for the orbit split's first program."""
+        part = self._inflate(part_f)
+        return self._expand_chunk_core_late(part, start, n_f)
+
+    def _orbit_fps_impl(self, children, lane, cp_raw):
+        """Jit target for the orbit split's second program."""
+        fv, ff, nd_ovf = self._orbit_chunk_fps(children, lane)
+        cv = jnp.where(lane, fv.astype(U64), SENT)
+        cf = jnp.where(lane, ff.astype(U64), SENT)
+        cp = jnp.where(lane, cp_raw, -1)
+        return cv, cf, cp, nd_ovf
+
+    def _expand_chunk_split(self, part_f: Frontier, start, n_f):
+        """Orbit chunk expand as two dispatches (children stay on device)."""
+        (children, lane, cp_raw, mult_slots, abort_at,
+         overflow) = self._expand_chunk_core(part_f, start, n_f)
+        cv, cf, cp, nd_ovf = self._orbit_fps(children, lane, cp_raw)
+        return cv, cf, cp, mult_slots, abort_at, overflow | nd_ovf
 
     def _orbit_chunk_fps(self, children, lane):
         """Orbit-pruned fingerprints for one chunk's compacted candidates.
@@ -712,8 +773,9 @@ class JaxChecker:
         the canonical-relabel hash; tied rows are compacted into a
         cap_x/4 sub-budget and run the exact min-over-P fold there.  If
         more than cap_x/4 rows are tied (early symmetric levels) the
-        chunk reports overflow — the engine's existing redo then doubles
-        cap_x, and with it this sub-budget, until the level fits.
+        chunk reports overflow — the engine's existing redo then grows
+        cap_x by half-steps (_cap_steps, ~1.5x), and with it this
+        sub-budget, until the level fits.
         Returns (fp_view, fp_full, overflow)."""
         fv, ff, disc = self.fpr.state_fingerprints_orbit(children)
         need = lane & ~disc
@@ -1566,7 +1628,8 @@ class JaxChecker:
         # directly; on mid-size levels it joins the level-wide concat as
         # G per-chunk-shaped entries.
         start0 = 0
-        if self.chunk >= self.span_min_chunk and n_chunks >= G:
+        if (self.chunk >= self.span_min_chunk and n_chunks >= G
+                and not self.orbit):
             span_rows = G * self.chunk
             for g in range(n_chunks // G):
                 b = jnp.asarray(g * span_rows, I64)
@@ -1724,6 +1787,7 @@ class JaxChecker:
             g_lo, g_hi = gi * G * self.chunk, (gi + 1) * G * self.chunk
             span_ok = (
                 self.chunk >= self.span_min_chunk
+                and not self.orbit
                 and (gi + 1) * G <= n_chunks
                 and g_lo // seg_len == (g_hi - 1) // seg_len
             )
@@ -2072,8 +2136,7 @@ class JaxChecker:
                     # against a 4x-chunk budget — 1.5x absorbs it
                     self.cap_x = _cap_steps(self.cap_x + 1)
                     self.cap_g = max(self.cap_g, self.G * self.cap_x // 2)
-                    self._expand_chunk = jax.jit(self._expand_chunk_impl)
-                    self._expand_span = jax.jit(self._expand_span_impl)
+                    self._jit_expand_programs()
                 if overflow_g:
                     self.cap_g *= 2
             if abort_at < n_f:
