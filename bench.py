@@ -195,6 +195,14 @@ def _best_window_rate(levels, fallback, max_level=None):
 
 def main():
     os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+    # mesh benches on a virtual CPU mesh need the device-count XLA flag
+    # pinned BEFORE the first jax import (tla_raft_tpu.xla_env does not
+    # import jax); real multi-chip meshes need nothing here
+    mesh_n = int(os.environ.get("BENCH_MESH", "0"))
+    if mesh_n and os.environ.get("JAX_PLATFORMS") == "cpu":
+        from tla_raft_tpu.xla_env import ensure_virtual_cpu_mesh
+
+        ensure_virtual_cpu_mesh(mesh_n)
     jax = _init_jax_or_reexec()
 
     # every stage before the engine run is wrapped so an exception
@@ -206,9 +214,19 @@ def main():
         from tla_raft_tpu.engine import JaxChecker
         from tla_raft_tpu.oracle import OracleChecker
 
-        cfg = load_raft_config(
-            os.environ.get("RAFT_CFG", "/root/reference/Raft.cfg")
-        )
+        cfg_path = os.environ.get("RAFT_CFG", "/root/reference/Raft.cfg")
+        if os.path.exists(cfg_path):
+            cfg = load_raft_config(cfg_path)
+        else:
+            # containers without the reference checkout: RaftConfig()
+            # defaults ARE the Raft.cfg constants (config.py docstring)
+            from tla_raft_tpu.config import RaftConfig
+
+            cfg = RaftConfig()
+            print(
+                f"[bench] {cfg_path} not found; using the built-in "
+                "reference constants", file=sys.stderr,
+            )
         overrides = {}
         if os.environ.get("BENCH_SERVERS"):
             overrides["n_servers"] = int(os.environ["BENCH_SERVERS"])
@@ -314,10 +332,35 @@ def main():
         )
         sys.stderr.flush()
 
+    exchange = None
+    peak_dev_rows = None
     try:
-        res = JaxChecker(cfg, chunk=chunk, progress=progress).run(
-            max_depth=max_depth
-        )
+        if mesh_n:
+            # distributed bench: the sharded checker on an N-device mesh
+            # (BENCH_MESH_DEEP=1 selects the 1/D-sharded deep-sweep path
+            # with the sieve+compress exchange; its per-level exchange
+            # bytes land in the canonical record below)
+            from tla_raft_tpu.parallel import ShardedChecker, make_mesh
+
+            deep = bool(int(os.environ.get("BENCH_MESH_DEEP", "0")))
+            fpdir = os.environ.get("BENCH_FPSTORE", "") or None
+            if deep and fpdir is None:
+                fpdir = "/tmp/bench_mesh_fps"
+            mchk = ShardedChecker(
+                cfg, make_mesh(mesh_n),
+                cap_x=int(os.environ.get("BENCH_CAP_X", "4096")),
+                host_store_dir=fpdir, deep=deep,
+                seg_rows=int(os.environ.get("BENCH_SEG_ROWS", str(1 << 15))),
+                progress=progress,
+            )
+            res = mchk.run(max_depth=max_depth)
+            if mchk.meter.levels:
+                exchange = mchk.meter.summary()
+            peak_dev_rows = getattr(mchk, "peak_dev_rows", None)
+        else:
+            res = JaxChecker(cfg, chunk=chunk, progress=progress).run(
+                max_depth=max_depth
+            )
     except Exception as e:
         _emit_failure("engine_run", e)
         return 1
@@ -418,6 +461,13 @@ def main():
             "match": golden_full_match,
             "advisory": golden_key in GOLDEN_FULL_SINGLE_SOURCE,
         }
+    if mesh_n:
+        out["mesh"] = mesh_n
+        out["mesh_deep"] = bool(int(os.environ.get("BENCH_MESH_DEEP", "0")))
+        if peak_dev_rows is not None:
+            out["peak_dev_rows"] = peak_dev_rows
+    if exchange is not None:
+        out["exchange"] = exchange
     if not parity:
         out["error"] = {
             "engine_levels": list(res.level_sizes[: len(prefix) + 2]),
@@ -426,6 +476,34 @@ def main():
             "violation": str(res.violation[0]) if res.violation else None,
         }
     print(json.dumps(out))
+    # canonical round record (BENCH_OUT=BENCH_rNN.json): one top-level
+    # machine-readable artifact per campaign step so the perf trajectory
+    # is greppable across rounds — config, steady rate, exchange
+    # bytes/level (mesh-deep runs), parity flag, wall
+    bench_out = os.environ.get("BENCH_OUT")
+    if bench_out:
+        record = {
+            "schema": "tla-raft-bench/1",
+            "metric": out["metric"],
+            "config": out["config"],
+            "steady_rate": out["value"],
+            "unit": out["unit"],
+            "parity": out["parity"],
+            "ok": out["ok"],
+            "wall_s": out["wall_s"],
+            "distinct": out["distinct"],
+            "generated": out["generated"],
+            "depth": out["depth"],
+            "vs_baseline": out["vs_baseline"],
+            "device": out["device"],
+        }
+        for k in ("mesh", "mesh_deep", "peak_dev_rows", "exchange"):
+            if k in out:
+                record[k] = out[k]
+        tmp = bench_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1)
+        os.replace(tmp, bench_out)
     return 0 if parity else 1
 
 

@@ -12,7 +12,7 @@ set -u
 cd "$(dirname "$0")/.."
 steps=("$@")
 [ $# -eq 0 ] && steps=(fix1 fix2 s3 s5)
-known=" fix1 fix2 s3 s3big s5 s7 s7base sweep "
+known=" fix1 fix2 s3 s3big s5 s7 s7base sweep sharded-sweep "
 for s in "${steps[@]}"; do
   case "$known" in
     *" $s "*) ;;
@@ -68,6 +68,18 @@ for s in "${steps[@]}"; do
         BENCH_GOLD_DEPTH=7 ;;
     sweep) # deep-sweep continuation: level 29+ under host paging
       scripts/run_sweep.sh || fail=1 ;;
+    sharded-sweep) # 1/D-sharded deep sweep with sieve+compress exchange
+      # (parallel/sharded.py deep mode).  On hardware this runs the real
+      # mesh; MESH_DEVICES + JAX_PLATFORMS=cpu gives the virtual-mesh
+      # measurement.  BENCH_OUT (the canonical schema record, exchange
+      # bytes/level included) and run_bench's raw stdout artifact are
+      # DIFFERENT files — run_bench's mv would clobber the record
+      # otherwise.
+      run_bench docs/BENCH_SHARDED_r06.json \
+        BENCH_MESH="${MESH_DEVICES:-8}" BENCH_MESH_DEEP=1 \
+        BENCH_MAX_DEPTH="${SHARDED_DEPTH:-11}" \
+        BENCH_FPSTORE=states_mesh_fp BENCH_OUT=BENCH_r06.json \
+        BENCH_NATIVE_DEPTH="${SHARDED_DEPTH:-11}" ;;
   esac
 done
 exit $fail

@@ -8,6 +8,12 @@
 # Set FPSTORE=<dir> to run the visited set on the external-memory C++
 # store instead of the device (deep levels: no device-resident
 # fingerprint table or big-table sort/searchsorted programs at all).
+# Set MESH=<D> to run the 1/D-SHARDED deep sweep instead (frontier
+# owner-sharded across D devices as uniform segment lists, sieve+
+# compress fingerprint exchange, double-buffered level tail) — this is
+# the architecture that moves the level-29 single-device HBM wall to
+# ~D x 15 GB; requires FPSTORE.  MESH_SEG_ROWS tunes the per-device
+# segment size (default 2^21 rows, matching engine/bfs.py SEG_ROWS).
 
 set -u
 cd "$(dirname "$0")/.."
@@ -47,11 +53,20 @@ while true; do
   if [ -n "${FPSTORE:-}" ]; then
     FPFLAGS=(--fpstore-dir "$FPSTORE")
   fi
+  MESHFLAGS=()
+  if [ -n "${MESH:-}" ]; then
+    if [ -z "${FPSTORE:-}" ]; then
+      echo "run_sweep: MESH=$MESH requires FPSTORE (per-owner stores)" >&2
+      exit 2
+    fi
+    MESHFLAGS=(--mesh "$MESH" --mesh-deep
+               --seg-rows "${MESH_SEG_ROWS:-2097152}")
+  fi
   python -m tla_raft_tpu.check \
     --config /root/reference/Raft.cfg \
     --chunk "$CHUNK" --canon "$CANON" \
     --checkpoint-dir "$CKDIR" --checkpoint-every 1 \
-    "${FPFLAGS[@]}" "${RECOVER[@]}" --json --log raft_sweep.log
+    "${FPFLAGS[@]}" "${MESHFLAGS[@]}" "${RECOVER[@]}" --json --log raft_sweep.log
   RC=$?
   if [ "$RC" -eq 0 ]; then
     echo "run_sweep: clean completion" >&2

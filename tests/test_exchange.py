@@ -1,0 +1,90 @@
+"""Unit tests for the sieve-and-compress exchange primitives.
+
+The delta/varint fingerprint packing must round-trip exactly — these
+bytes carry the visited-set membership question, so a single corrupted
+fingerprint is a silently wrong model-checking verdict.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tla_raft_tpu.parallel.exchange import (
+    ExchangeMeter, pack_fp_deltas, packed_quantum, unpack_fp_deltas,
+)
+
+SENT = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _roundtrip(fps: np.ndarray, cap: int):
+    pad = np.full(cap - len(fps), SENT)
+    arr = jnp.asarray(np.concatenate([fps, pad]))
+    stream, nib, total = pack_fp_deltas(arr, jnp.asarray(len(fps)))
+    stream, nib, total = (
+        np.asarray(stream), np.asarray(nib), int(total),
+    )
+    out = unpack_fp_deltas(stream[:total], nib, len(fps))
+    np.testing.assert_array_equal(out, fps)
+    return total
+
+
+def test_pack_roundtrip_random():
+    rng = np.random.default_rng(7)
+    fps = np.unique(rng.integers(0, 1 << 63, 1000, dtype=np.uint64))
+    total = _roundtrip(fps, 1024)
+    # sorted random u64s carry ~(64 - log2 n) bits each; the varint
+    # encoding must beat raw u64 lanes on any realistically sized batch
+    assert total < 8 * len(fps)
+
+
+def test_pack_roundtrip_edge_cases():
+    # empty
+    assert len(unpack_fp_deltas(np.empty(0, np.uint8),
+                                np.empty(0, np.uint8), 0)) == 0
+    # single small / single huge
+    _roundtrip(np.array([1], np.uint64), 8)
+    _roundtrip(np.array([0xFFFFFFFFFFFFFFFE], np.uint64), 8)
+    # adjacent values (delta 1 — the 1-byte fast path)
+    _roundtrip(np.arange(100, 200, dtype=np.uint64), 128)
+    # deltas straddling every byte-width boundary
+    vals = np.cumsum(
+        np.array([1, 0xFF, 0x100, 0xFFFF, 0x10000, 0xFFFFFFFF,
+                  0x100000000, 0xFFFFFFFFFFFF, 0x1000000000000],
+                 np.uint64)
+    )
+    _roundtrip(vals, 16)
+
+
+def test_pack_zero_first_value():
+    # fp 0 is legal (delta 0 from the implicit -1 base encodes as 1 byte)
+    _roundtrip(np.array([0, 5, 1 << 40], np.uint64), 8)
+
+
+def test_packed_quantum_ladder():
+    assert packed_quantum(1) == 1
+    assert packed_quantum(3) == 3
+    assert packed_quantum(5) == 6
+    assert packed_quantum(100) == 128
+    for n in (1, 7, 100, 4097):
+        assert packed_quantum(n) >= n
+    # the ladder is O(log): few distinct values over a wide range
+    qs = {packed_quantum(n) for n in range(1, 100000)}
+    assert len(qs) < 40
+
+
+def test_meter_reduction():
+    m = ExchangeMeter()
+    m.begin_level(1)
+    m.add(a2a_bytes=100, host_bytes=100, raw_a2a_bytes=300,
+          raw_host_bytes=500, n_candidates=10, n_sieved=4, n_unique=5)
+    lv = m.end_level()
+    assert lv["exchanged_bytes"] == 200
+    assert lv["reduction"] == 4.0
+    s = m.summary()
+    assert s["raw_bytes"] == 800 and s["sieved"] == 4
+
+
+def test_meter_empty_level():
+    m = ExchangeMeter()
+    m.begin_level(1)
+    assert m.end_level()["reduction"] is None

@@ -1,0 +1,37 @@
+"""Importing the package must never initialize an XLA backend.
+
+Module-scope ``jnp.uint64(...)`` constants used to force client creation
+during pytest collection, aborting the whole tier-1 suite on hosts with
+no usable backend.  The subprocess sets JAX_PLATFORMS to a nonexistent
+platform: any import-time backend touch then fails loudly, while a
+device-free import succeeds.
+"""
+
+import os
+import subprocess
+import sys
+
+MODULES = [
+    "tla_raft_tpu",
+    "tla_raft_tpu.engine.bfs",
+    "tla_raft_tpu.parallel.sharded",
+    "tla_raft_tpu.parallel.exchange",
+    "tla_raft_tpu.engine.forecast",
+    "tla_raft_tpu.ops.fingerprint",
+    "tla_raft_tpu.check",
+    "tla_raft_tpu.xla_env",
+]
+
+
+def test_imports_are_device_free():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "no_such_platform"
+    env.pop("XLA_FLAGS", None)
+    code = "import " + ", ".join(MODULES) + "\nprint('IMPORT_OK')"
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env, capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "IMPORT_OK" in proc.stdout

@@ -149,6 +149,18 @@ def main(argv=None) -> int:
                    help="run distributed over an N-device mesh (0 = single device)")
     p.add_argument("--exchange", choices=("all_to_all", "all_gather"),
                    default="all_to_all", help="distributed fingerprint exchange")
+    p.add_argument("--mesh-deep", action="store_true",
+                   help="sharded deep sweep: frontier 1/D across devices "
+                        "as uniform segment lists, sieve-and-compress "
+                        "fingerprint exchange, double-buffered level tail "
+                        "(requires --mesh and --fpstore-dir)")
+    p.add_argument("--seg-rows", type=int, default=1 << 15,
+                   help="rows per frontier segment per device (deep mode)")
+    p.add_argument("--no-sieve", action="store_true",
+                   help="deep mode: disable the pre-routing visited sieve")
+    p.add_argument("--no-compress", action="store_true",
+                   help="deep mode: fetch raw u64 fingerprints instead of "
+                        "the delta-packed stream")
     p.add_argument("--cap-x", type=int, default=4096,
                    help="per-device candidate capacity (distributed mode)")
     p.add_argument("--canon", choices=("late", "expand"), default="late",
@@ -185,6 +197,12 @@ def main(argv=None) -> int:
 
     logf = open(args.log, "w") if args.log and args.log != "-" else None
     out = Tee(sys.stdout, logf) if logf else sys.stdout
+    if args.mesh_deep and not args.mesh:
+        # without this guard the run would silently fall through to the
+        # single-device engine and be mistaken for a deep-sweep result
+        print("--mesh-deep requires --mesh N (the sharded deep sweep "
+              "runs on a device mesh)", file=out)
+        return 2
     t0 = time.monotonic()
     print(f"tla-raft-tpu checker: backend={args.backend}", file=out)
     print(f"Config {args.config}: {cfg.describe()}", file=out)
@@ -242,6 +260,11 @@ def main(argv=None) -> int:
             print(f"Native FP store: {args.fpstore_dir}", file=out)
 
         if args.mesh:
+            if args.mesh_deep and not args.fpstore_dir:
+                print("--mesh-deep requires --fpstore-dir (the sharded "
+                      "deep sweep filters through per-owner external "
+                      "stores)", file=out)
+                return 2
             if args.fpstore_dir:
                 # mesh x external store: one HostFPStore per owner shard
                 # (fp % D), host-filtered after the all_to_all routing
@@ -249,16 +272,39 @@ def main(argv=None) -> int:
                       f"{args.fpstore_dir}", file=out)
             from .parallel import ShardedChecker, make_mesh
 
-            res = ShardedChecker(
+            chk = ShardedChecker(
                 cfg, make_mesh(args.mesh), cap_x=args.cap_x,
                 exchange=args.exchange, progress=progress, canon=args.canon,
                 host_store_dir=args.fpstore_dir or None,
-            ).run(
+                deep=args.mesh_deep, seg_rows=args.seg_rows,
+                sieve=not args.no_sieve, compress=not args.no_compress,
+            )
+            res = chk.run(
                 max_depth=args.max_depth,
                 checkpoint_dir=args.checkpoint_dir,
                 checkpoint_every=args.checkpoint_every,
                 resume_from=args.recover,
             )
+            if args.mesh_deep and chk.meter.levels:
+                # run-summary exchange ledger: the sieve+compress bytes
+                # vs what the uncompressed exchange would have moved
+                s = chk.meter.summary()
+                print(
+                    f"Exchange: {s['exchanged_bytes']:,} fp bytes over "
+                    f"{s['levels']} levels (uncompressed equivalent "
+                    f"{s['raw_bytes']:,}; reduction {s['reduction']}x; "
+                    f"sieved {s['sieved']:,} of {s['candidates']:,} "
+                    "candidates)",
+                    file=out,
+                )
+                for lv in s["per_level"]:
+                    print(
+                        f"  level {lv['level']}: {lv['exchanged_bytes']:,}"
+                        f" B (raw {lv['raw_bytes']:,} B, "
+                        f"x{lv['reduction']}), sieved {lv['n_sieved']:,}"
+                        f"/{lv['n_candidates']:,}",
+                        file=out,
+                    )
         else:
             res = JaxChecker(
                 cfg, chunk=args.chunk, progress=progress,

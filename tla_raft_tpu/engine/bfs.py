@@ -74,8 +74,11 @@ U64 = jnp.uint64
 I64 = jnp.int64
 I32 = jnp.int32
 U32C = jnp.uint32
-SENT = jnp.uint64(0xFFFFFFFFFFFFFFFF)
-BIG = jnp.int64(1 << 62)
+# numpy scalars, not jnp: a module-scope jnp.uint64(...) call would force
+# XLA client creation at IMPORT time, aborting pytest collection on hosts
+# with no usable backend (numpy scalars promote identically inside jit)
+SENT = np.uint64(0xFFFFFFFFFFFFFFFF)
+BIG = np.int64(1 << 62)
 
 
 class CheckResult(NamedTuple):

@@ -138,3 +138,30 @@ def forecast_peak_new(level_sizes, target_depth: int | None) -> int:
     """Forecast the largest per-level new-state count over the run."""
     fut = forecast_new_states(level_sizes, target_depth)
     return max(fut, default=0)
+
+
+def per_device_forecast(level_sizes, distinct: int,
+                        target_depth: int | None, n_devices: int):
+    """Per-device capacity signal for the 1/D-sharded deep-sweep mesh.
+
+    Fingerprint ownership (fp % D) is hash-uniform, so each device's
+    share of a forecast level is ~peak/D with multiplicative skew that
+    shrinks as levels grow; the 1.35x margin covers the +3-sigma
+    binomial skew down to ~100-state shares (below that the absolute
+    +32 floor dominates).  Returns None when there is no usable signal,
+    else a dict of per-device row forecasts:
+
+      peak_rows:  largest per-level new-state share one device owns
+      final_rows: final distinct-state share one device owns (sieve /
+                  store-cache sizing)
+      budget:     TLA_RAFT_PRESIZE_BYTES, passed through for the same
+                  clamping the engines already apply
+    """
+    sig = horizon_forecast(level_sizes, distinct, target_depth)
+    if sig is None:
+        return None
+    peak_new, final_distinct, budget = sig
+    share = peak_new / n_devices
+    peak_rows = int(share * 1.35) + 32
+    final_rows = int(final_distinct / n_devices * 1.35) + 32
+    return dict(peak_rows=peak_rows, final_rows=final_rows, budget=budget)

@@ -171,3 +171,24 @@ class HostFPStore:
             self.close()
         except Exception:
             pass
+
+
+def insert_sharded(stores: list, fps: np.ndarray) -> int:
+    """Split ``fps`` by owner (fp % len(stores)) and insert each share
+    into its store concurrently; returns the total newly-inserted count.
+
+    The ctypes insert releases the GIL for the C++ sort/merge/spill, so
+    D shards insert in parallel on a multi-core host — the deep-sweep
+    mesh uses this to rebuild its per-owner stores on resume (and its
+    level loop uses the same property for the double-buffered tail)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    D = len(stores)
+    fps = np.ascontiguousarray(fps, np.uint64)
+    shares = [np.sort(fps[fps % np.uint64(D) == o]) for o in range(D)]
+
+    def one(o):
+        return int(stores[o].insert(shares[o]).sum()) if len(shares[o]) else 0
+
+    with ThreadPoolExecutor(max_workers=min(D, os.cpu_count() or 2)) as ex:
+        return sum(ex.map(one, range(D)))

@@ -13,6 +13,68 @@ points (round-4 advisor finding).
 from __future__ import annotations
 
 import os
+import subprocess
+import sys
+
+# XLA aborts the whole process (LOG(FATAL) in parse_flags_from_env) on any
+# flag the linked jaxlib doesn't know, so the collective-timeout guards
+# below must be probed before they are pinned into XLA_FLAGS.  The probe
+# result is cached per jaxlib version (file + env var, so child processes
+# skip it).
+_COLL_FLAGS = (
+    " --xla_cpu_collective_call_terminate_timeout_seconds=3600"
+    " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
+)
+_PROBE_ENV = "TLA_RAFT_XLA_COLL_FLAGS_OK"
+
+
+def _collective_flags_supported() -> bool:
+    """True iff this jaxlib accepts the CPU collective-timeout flags."""
+    cached = os.environ.get(_PROBE_ENV)
+    if cached is not None:
+        return cached == "1"
+    try:
+        from importlib.metadata import version
+
+        tag = version("jaxlib")
+    except Exception:
+        tag = "unknown"
+    cache_dir = os.path.expanduser("~/.cache/tla_raft_tpu")
+    cache = os.path.join(cache_dir, f"xla_coll_flags_{tag}")
+    if os.path.exists(cache):
+        with open(cache) as f:
+            ok = f.read().strip() == "1"
+        os.environ[_PROBE_ENV] = "1" if ok else "0"
+        return ok
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = _COLL_FLAGS.strip()
+    env.pop("PYTHONSTARTUP", None)
+    durable = True
+    try:
+        ok = (
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                env=env, capture_output=True, timeout=120,
+            ).returncode
+            == 0
+        )
+    except Exception:
+        # a timeout/OSError is TRANSIENT (loaded host), not a verdict on
+        # the jaxlib — run without the guards this process, but do not
+        # poison the per-version cache (a clean non-zero exit IS the
+        # deterministic unknown-flag fatal and is safe to cache)
+        ok = False
+        durable = False
+    if durable:
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            with open(cache, "w") as f:
+                f.write("1" if ok else "0")
+        except OSError:
+            pass
+    os.environ[_PROBE_ENV] = "1" if ok else "0"
+    return ok
 
 
 def ensure_virtual_cpu_mesh(n_devices: int = 8) -> None:
@@ -23,13 +85,15 @@ def ensure_virtual_cpu_mesh(n_devices: int = 8) -> None:
         xla = (
             xla + f" --xla_force_host_platform_device_count={n_devices}"
         ).strip()
-    if "collective_call_terminate" not in xla:
+    if (
+        "collective_call_terminate" not in xla
+        and _collective_flags_supported()
+    ):
         # virtual devices timeshare the host CPU; XLA aborts the whole
         # process when a collective's participant threads miss a 40 s
         # hard rendezvous window (hit at ~100k-state virtual-mesh levels
-        # on a 1-core host).  Wall-clock guards, not correctness knobs.
-        xla += (
-            " --xla_cpu_collective_call_terminate_timeout_seconds=3600"
-            " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
-        )
+        # on a 1-core host).  Wall-clock guards, not correctness knobs —
+        # jaxlibs that don't know the flags simply run without them
+        # (unknown XLA_FLAGS are themselves a fatal abort, see probe).
+        xla += _COLL_FLAGS
     os.environ["XLA_FLAGS"] = xla
