@@ -1,0 +1,42 @@
+"""Seeded graftlint violations — at least one per rule.
+
+NEVER imported: tests/test_analysis.py lints this file as SOURCE (with
+a hot-loop relpath so the path-scoped rules fire) and asserts every
+``expect[RULE]`` marker below is caught.  The markers are plain
+comments; they do not waive anything.
+"""
+
+import os  # expect[GL008]
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+
+SENT = jnp.uint64(0xFFFFFFFFFFFFFFFF)  # expect[GL001]
+
+
+@jax.jit
+def kernel(x):
+    t = time.monotonic()  # expect[GL002]
+    if jnp.any(x > 0):  # expect[GL004]
+        x = x + 1
+    off = jnp.cumsum(x).astype(jnp.int32)  # expect[GL005]
+    return x * t + off[0]
+
+
+def seed_jitter() -> float:
+    # keeps `random` used so the only GL008 seed is `os` above
+    return random.random()
+
+
+def level_tail(pool, arr):
+    try:
+        fetched = jax.device_get(arr)  # expect[GL006]
+    except Exception:  # expect[GL003]
+        fetched = None
+    return pool.submit(worker, fetched)  # expect[GL007]
+
+
+def worker(buf):
+    return jnp.sum(jnp.asarray(buf))

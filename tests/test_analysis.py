@@ -1,0 +1,282 @@
+"""graftlint subsystem tests (tla_raft_tpu/analysis/).
+
+Layer 1 (AST lint): every rule catches its seeded fixture violation,
+waivers and the baseline suppress findings, and the repo itself is at a
+zero-unwaived-finding start (the CI gate, asserted in-tree).
+Layer 2 (jaxpr audit): the hot kernels match the committed golden
+ledger and the hard rules flag planted offenders.
+Layer 3 (sanitizer): a GRAFT_SANITIZE=1 smoke check run reports zero
+post-warmup recompiles and zero unledgered transfers; a planted
+per-level retrace is flagged; worker threads marked no-dispatch
+cannot reach device dispatch helpers.
+"""
+
+import os
+import re
+import subprocess
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from tla_raft_tpu.analysis import RULE_IDS, ast_lint, sanitize
+from tla_raft_tpu.analysis.__main__ import main as analysis_main
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+PKG = os.path.join(REPO, "tla_raft_tpu")
+FIXTURE = os.path.join(HERE, "fixtures", "graftlint_bad.py")
+
+# linted under a hot-loop parallel/ relpath so the path-scoped rules
+# (GL005 width discipline, GL006 sync ledger) fire on the fixture
+FIXTURE_RELPATH = "tla_raft_tpu/parallel/sharded.py"
+
+
+def _lint_fixture():
+    with open(FIXTURE) as fh:
+        src = fh.read()
+    return src, ast_lint.lint_source(src, FIXTURE, FIXTURE_RELPATH)
+
+
+def test_every_rule_catches_its_seeded_violation():
+    src, findings = _lint_fixture()
+    expected = {}  # rule -> line number of the expect[] marker
+    for i, line in enumerate(src.splitlines(), start=1):
+        for m in re.finditer(r"expect\[(GL\d+)\]", line):
+            expected[m.group(1)] = i
+    assert set(expected) == set(RULE_IDS), "fixture must seed all rules"
+    got = {(f.rule, f.line) for f in findings}
+    for rule, line in expected.items():
+        assert (rule, line) in got, (
+            f"{rule} not caught at fixture line {line}; findings: "
+            + "\n".join(f.format() for f in findings)
+        )
+
+
+def test_waiver_suppresses_only_named_rule():
+    src = (
+        "import jax.numpy as jnp\n"
+        "A = jnp.zeros(4)  # graftlint: waive[GL001]\n"
+        "B = jnp.ones(4)\n"
+        "# graftlint: waive[GL001]\n"
+        "C = jnp.arange(4)\n"
+        "D = jnp.eye(4)  # graftlint: waive[GL003]\n"
+    )
+    findings = ast_lint.lint_source(src, "<mem>", "tla_raft_tpu/x.py")
+    lines = {f.line for f in findings if f.rule == "GL001"}
+    assert 2 not in lines, "same-line waiver must suppress"
+    assert 5 not in lines, "line-above waiver must suppress"
+    assert 3 in lines, "unwaived line must still be reported"
+    assert 6 in lines, "a waiver for another rule must not suppress"
+
+
+def test_waiver_star_suppresses_everything():
+    src = "import jax.numpy as jnp\nA = jnp.zeros(3)  # graftlint: waive[*]\n"
+    assert ast_lint.lint_source(src, "<mem>", "tla_raft_tpu/x.py") == []
+
+
+def test_gl007_sees_executors_regardless_of_variable_name():
+    src = (
+        "import jax.numpy as jnp\n"
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "def one(o):\n"
+        "    return jnp.sum(jnp.zeros(o))\n"
+        "def tail(shares):\n"
+        "    with ThreadPoolExecutor(2) as ex:\n"
+        "        return sum(ex.map(one, shares))\n"
+    )
+    findings = ast_lint.lint_source(src, "<mem>", "tla_raft_tpu/x.py")
+    assert any(f.rule == "GL007" for f in findings), [
+        f.format() for f in findings
+    ]
+
+
+def test_baseline_roundtrip(tmp_path):
+    _src, findings = _lint_fixture()
+    assert findings
+    path = str(tmp_path / "baseline.json")
+    ast_lint.write_baseline(findings, path)
+    baseline = ast_lint.load_baseline(path)
+    kept, suppressed = ast_lint.apply_baseline(findings, baseline)
+    assert kept == []
+    assert suppressed == len(findings)
+    # a NEW finding (not in the baseline) must survive suppression
+    extra = ast_lint.Finding(
+        "GL006", "tla_raft_tpu/engine/bfs.py", 1, 0, "m",
+        "jax.device_get(new_site)",
+    )
+    kept2, _ = ast_lint.apply_baseline(findings + [extra], baseline)
+    assert kept2 == [extra]
+
+
+def test_repo_is_at_zero_finding_start():
+    """The acceptance gate, in-tree: the package lints clean against the
+    committed baseline (same check CI runs via the analysis job)."""
+    findings = ast_lint.lint_paths([PKG], root=REPO)
+    baseline = ast_lint.load_baseline()
+    kept, _ = ast_lint.apply_baseline(findings, baseline)
+    assert kept == [], "unwaived graftlint findings:\n" + "\n".join(
+        f.format() for f in kept
+    )
+
+
+def test_cli_exit_codes():
+    assert analysis_main(["--no-jaxpr"]) == 0
+    # without the baseline the GL006 sync ledger must trip the gate
+    assert analysis_main(["--no-jaxpr", "--no-baseline"]) == 1
+    assert analysis_main(["--select", "GL999"]) == 2
+
+
+# -- layer 2: jaxpr audit -------------------------------------------------
+
+def test_jaxpr_ledger_matches_golden():
+    import jax
+
+    from tla_raft_tpu.analysis import jaxpr_audit
+
+    golden = jaxpr_audit.load_golden()
+    assert golden is not None, "golden_ledger.json must be committed"
+    failures, warnings = jaxpr_audit.audit(golden)
+    assert failures == [], failures
+    if golden["_meta"]["jax"] == jax.__version__:
+        assert warnings == [], warnings
+
+
+def test_jaxpr_audit_flags_planted_offenders():
+    import jax
+    import jax.numpy as jnp
+
+    from tla_raft_tpu.analysis import jaxpr_audit
+
+    def with_callback(x):
+        return jax.pure_callback(
+            lambda a: a, jax.ShapeDtypeStruct(x.shape, x.dtype), x
+        )
+
+    led = jaxpr_audit.primitive_ledger(
+        jax.make_jaxpr(with_callback)(jnp.arange(4.0, dtype=jnp.float32))
+    )
+    assert set(led["primitives"]) & jaxpr_audit.FORBIDDEN_PRIMITIVES
+
+    def with_f64(x):
+        return x.astype(jnp.float64) * 2.0
+
+    led64 = jaxpr_audit.primitive_ledger(
+        jax.make_jaxpr(with_f64)(jnp.arange(4, dtype=jnp.int32))
+    )
+    assert "float64" in led64["dtypes"]
+
+    def with_narrow(x):
+        return x.astype(jnp.int32)
+
+    ledn = jaxpr_audit.primitive_ledger(
+        jax.make_jaxpr(with_narrow)(jnp.zeros((4,), jnp.int64))
+    )
+    assert ledn["primitives"].get("convert_element_type[narrow64]") == 1
+
+
+# -- layer 3: runtime sanitizer -------------------------------------------
+
+def test_sanitizer_ledgers_explicit_and_flags_implicit():
+    import jax
+    import jax.numpy as jnp
+
+    with sanitize.Sanitizer(warmup_levels=0, strict=True) as san:
+        x = jnp.arange(8)
+        jax.device_get(x)
+        assert san.n_ledgered_get == 1
+        with pytest.raises(RuntimeError, match="unledgered"):
+            int(x[0])
+    assert san.n_implicit == 1
+    assert sanitize.CURRENT is None  # cleanly unwound
+
+
+def test_sanitizer_flags_silent_per_level_retrace():
+    import jax
+    import jax.numpy as jnp
+
+    with sanitize.Sanitizer(warmup_levels=1, strict=False) as san:
+        for level in range(4):
+            # a fresh jit wrapper per level = the silent-retrace bug class
+            f = jax.jit(lambda x, _lv=level: x * (_lv + 2))
+            f(jnp.arange(4))
+            san.level_tick()
+    assert san.violations, "per-level retraces after warmup must be flagged"
+    assert not san.ok
+
+
+def test_sanitizer_accepts_declared_shape_events():
+    import jax
+    import jax.numpy as jnp
+
+    with sanitize.Sanitizer(warmup_levels=0, strict=False) as san:
+        for level in range(3):
+            sanitize.note_shape_event(f"grow to {level}")
+            f = jax.jit(lambda x, _lv=level: x + _lv)
+            f(jnp.arange(4))
+            san.level_tick()
+    assert san.violations == []
+    assert san.ok
+
+
+def test_worker_thread_dispatch_guard():
+    pool = ThreadPoolExecutor(
+        max_workers=1,
+        initializer=sanitize.forbid_device_dispatch_in_thread,
+    )
+    try:
+        with pytest.raises(RuntimeError, match="worker thread"):
+            pool.submit(sanitize.assert_device_dispatch_ok).result()
+        # inert marker: plain host work in the same worker is untouched
+        assert pool.submit(lambda: 42).result() == 42
+    finally:
+        pool.shutdown()
+    # the main thread is never marked
+    sanitize.assert_device_dispatch_ok()
+
+
+def test_sharded_io_pool_workers_are_marked():
+    """The always-on satellite wiring: ShardedChecker's pools must mark
+    their workers no-dispatch (without instantiating a full checker —
+    the initializer is what matters)."""
+    import inspect
+
+    from tla_raft_tpu.parallel import sharded
+
+    src = inspect.getsource(sharded.ShardedChecker._io_pool.func)
+    assert "forbid_device_dispatch_in_thread" in src
+    src_ck = inspect.getsource(sharded.ShardedChecker._ck_pool.func)
+    assert "forbid_device_dispatch_in_thread" in src_ck
+
+
+TINY_CFG = """\
+CONSTANTS
+  Servers = {s1, s2}
+  Vals = {v1}
+  MaxElection = 1
+  MaxRestart = 1
+INIT Init
+NEXT Next
+INVARIANT Inv
+"""
+
+
+def test_sanitize_smoke_check_run(tmp_path):
+    """Acceptance: a GRAFT_SANITIZE=1 smoke check run reports zero
+    post-warmup recompiles and zero unledgered host transfers."""
+    cfg = tmp_path / "tiny.cfg"
+    cfg.write_text(TINY_CFG)
+    env = dict(os.environ)
+    env.update(GRAFT_SANITIZE="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tla_raft_tpu.check",
+         "--config", str(cfg), "--chunk", "64",
+         "--log", str(tmp_path / "raft.log")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "Sanitizer: OK" in proc.stdout
+    assert "0 post-warmup unexpected recompiles" in proc.stdout
+    assert "0 unledgered host transfers" in proc.stdout
+    assert "0 worker-thread device dispatches" in proc.stdout
+    assert "Model checking completed" in proc.stdout

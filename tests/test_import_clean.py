@@ -20,7 +20,25 @@ MODULES = [
     "tla_raft_tpu.ops.fingerprint",
     "tla_raft_tpu.check",
     "tla_raft_tpu.xla_env",
+    "tla_raft_tpu.analysis",
+    "tla_raft_tpu.analysis.ast_lint",
+    "tla_raft_tpu.analysis.sanitize",
 ]
+
+
+def test_no_import_time_dispatch_static():
+    """The graftlint GL001 rule is this test's static twin: the
+    subprocess below proves today's imports are device-free; the rule
+    keeps NEW module-scope jnp/jax calls from ever landing (the PR 1
+    incident: a module-scope ``jnp.uint64(...)`` aborted collection of
+    the whole tier-1 suite on XLA-less hosts)."""
+    from tla_raft_tpu.analysis import ast_lint
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = ast_lint.lint_paths(
+        [os.path.join(repo, "tla_raft_tpu")], root=repo, select={"GL001"}
+    )
+    assert findings == [], "\n".join(f.format() for f in findings)
 
 
 def test_imports_are_device_free():

@@ -1,0 +1,35 @@
+"""graftlint: static analysis + trace sanitation for the TPU checker.
+
+Three layers, each machine-checking a bug class that PR 1 shipped and
+code review missed (docs/ANALYSIS.md has the incident-by-incident
+rationale):
+
+* **AST lint** (:mod:`.ast_lint`) — repo-specific source rules: no
+  device dispatch at import time, no wall-clock/random inside traced
+  functions, no blanket excepts, no Python branching on traced values,
+  i64 width discipline for row/offset arithmetic, a pinned ledger of
+  host-sync call sites in the hot level loops, no jax from thread-pool
+  workers, no unused imports.  Waivable inline
+  (``# graftlint: waive[RULE]``) and baselined
+  (:data:`.ast_lint.BASELINE_PATH`).
+* **jaxpr audit** (:mod:`.jaxpr_audit`) — lowers the registered hot
+  kernels to closed jaxprs and diffs their primitive ledgers against a
+  committed golden ledger; host callbacks, stray collectives and f64
+  are hard failures.
+* **runtime sanitizer** (:mod:`.sanitize`) — ``GRAFT_SANITIZE=1`` wraps
+  a check run with a host-transfer ledger, a per-level compile-count
+  ledger, and a worker-thread device-dispatch guard.
+
+CLI: ``python -m tla_raft_tpu.analysis`` (exit 0 = zero unwaived
+findings and no ledger drift — the CI gate).
+
+This module imports nothing heavier than stdlib so the package import
+stays device-free (tests/test_import_clean.py).
+"""
+
+from __future__ import annotations
+
+RULE_IDS = (
+    "GL001", "GL002", "GL003", "GL004",
+    "GL005", "GL006", "GL007", "GL008",
+)

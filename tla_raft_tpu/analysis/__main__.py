@@ -1,0 +1,112 @@
+"""CLI: ``python -m tla_raft_tpu.analysis`` — the graftlint gate.
+
+Default run = AST lint over the package (baseline applied) + jaxpr
+audit against the committed golden ledger.  Exit codes: 0 = clean,
+1 = unwaived findings or ledger drift, 2 = usage error.
+
+Maintenance flows:
+  --write-baseline   regenerate baseline.json from the current findings
+                     (review the diff — it is the accepted-debt ledger)
+  --write-ledger     regenerate golden_ledger.json from the current
+                     kernel jaxprs (justify the drift in the PR)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import ast_lint, jaxpr_audit
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tla_raft_tpu.analysis")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the package)")
+    p.add_argument("--select", action="append", default=None,
+                   metavar="RULE", help="run only these rules (repeatable)")
+    p.add_argument("--no-jaxpr", action="store_true",
+                   help="skip the jaxpr audit (layer 2 needs jax)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report baselined findings too")
+    p.add_argument("--baseline", default=ast_lint.BASELINE_PATH,
+                   help="baseline file (default: the committed one)")
+    p.add_argument("--ledger", default=jaxpr_audit.LEDGER_PATH,
+                   help="golden ledger file (default: the committed one)")
+    p.add_argument("--write-baseline", action="store_true")
+    p.add_argument("--write-ledger", action="store_true")
+    p.add_argument("--json", action="store_true",
+                   help="emit one machine-readable summary line")
+    args = p.parse_args(argv)
+
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = os.path.dirname(pkg_dir)
+    paths = args.paths or [pkg_dir]
+    select = set(args.select) if args.select else None
+    unknown = (select or set()) - set(ast_lint.RULES)
+    if unknown:
+        print(f"unknown rule(s): {sorted(unknown)}", file=sys.stderr)
+        return 2
+
+    findings = ast_lint.lint_paths(paths, root=root, select=select)
+
+    if args.write_baseline:
+        ast_lint.write_baseline(findings, args.baseline)
+        print(
+            f"wrote {len(findings)} finding(s) to {args.baseline}",
+        )
+        return 0
+
+    suppressed = 0
+    if not args.no_baseline:
+        baseline = ast_lint.load_baseline(args.baseline)
+        findings, suppressed = ast_lint.apply_baseline(findings, baseline)
+
+    failures: list[str] = []
+    warnings: list[str] = []
+    if args.write_ledger:
+        ledger = jaxpr_audit.build_ledger()
+        jaxpr_audit.write_golden(ledger, args.ledger)
+        n = len(ledger) - 1
+        print(f"wrote {n} kernel ledgers to {args.ledger}")
+        return 0
+    if not args.no_jaxpr:
+        golden = jaxpr_audit.load_golden(args.ledger)
+        if golden is None and args.ledger != jaxpr_audit.LEDGER_PATH:
+            # an explicit --ledger that doesn't exist is a usage error,
+            # not a silent audit against nothing (or the wrong default)
+            print(f"--ledger {args.ledger}: no such file", file=sys.stderr)
+            return 2
+        failures, warnings = jaxpr_audit.audit(golden)
+
+    for f in findings:
+        print(f.format())
+    for w in warnings:
+        print(f"warning: jaxpr-audit: {w}")
+    for f in failures:
+        print(f"FAIL: jaxpr-audit: {f}")
+
+    ok = not findings and not failures
+    summary = dict(
+        ok=ok,
+        findings=len(findings),
+        baselined=suppressed,
+        jaxpr_failures=len(failures),
+        jaxpr_warnings=len(warnings),
+    )
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(
+            f"graftlint: {len(findings)} unwaived finding(s), "
+            f"{suppressed} baselined, {len(failures)} jaxpr failure(s), "
+            f"{len(warnings)} warning(s) — "
+            + ("OK" if ok else "FAIL")
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,650 @@
+"""graftlint layer 1: repo-specific AST rules over the package source.
+
+Each rule encodes a bug class this project actually shipped (PR 1) or a
+discipline the kernels depend on; docs/ANALYSIS.md documents every rule
+with the incident that motivated it.  Two suppression mechanisms:
+
+* **waiver** — ``# graftlint: waive[GL003]`` (comma list, or ``[*]``) on
+  the finding's line or the line directly above it: the reviewed,
+  justified exception, kept next to the code it excuses.
+* **baseline** — a committed JSON inventory
+  (``tla_raft_tpu/analysis/baseline.json``) keyed by
+  ``rule|path|stripped-line-text`` with per-key counts.  Used for rules
+  that LEDGER existing sites rather than ban them (GL006 host syncs):
+  the inventory pins today's count, so a NEW sync site fails CI until
+  it is deliberately baselined or waived.  Line-text keys survive line
+  drift; an edited line re-surfaces as a fresh finding, which is the
+  point — the sync was touched, re-justify it.
+
+All analysis is pure stdlib ``ast`` — no imports of the linted modules,
+so the linter itself can never initialize a backend.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+
+RULES = {
+    "GL001": "import-time-dispatch: jax/jnp call at module import time",
+    "GL002": "impure-in-traced: wall-clock/random call inside a traced "
+             "function",
+    "GL003": "broad-except: bare `except:` or blanket "
+             "`except Exception`",
+    "GL004": "traced-branch: Python `if`/`while` on a traced (jnp/lax) "
+             "expression inside a traced function",
+    "GL005": "narrow-offset: i32 cast on row/offset arithmetic in "
+             "native/ or parallel/ call sites",
+    "GL006": "host-sync-ledger: host-sync call site in a hot-loop "
+             "module (new sites must be baselined or waived)",
+    "GL007": "worker-device-dispatch: jax/jnp reference inside a "
+             "function handed to a thread pool",
+    "GL008": "unused-import: imported name never used",
+}
+
+# GL006 applies only to the hot level-loop modules (the ~140-site sync
+# inventory the subsystem exists to pin down).
+HOT_LOOP_SUFFIXES = (
+    os.path.join("engine", "bfs.py"),
+    os.path.join("parallel", "sharded.py"),
+)
+# GL005 applies to the modules doing row/offset arithmetic against
+# >2^32-row stores (the PR 1 i32-overflow incident class).
+WIDTH_DIRS = (
+    os.path.join("tla_raft_tpu", "native"),
+    os.path.join("tla_raft_tpu", "parallel"),
+)
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+_WAIVE_RE = re.compile(r"graftlint:\s*waive\[([A-Za-z0-9*,\s]+)\]")
+_OFFSET_NAME_RE = re.compile(
+    r"off|offset|row|base|start|rank|cum|idx|pos|gpid|pidx|seek",
+    re.IGNORECASE,
+)
+_I32_NAMES = {"I32", "int32"}
+_IMPURE_CALLS = re.compile(
+    r"^(time\.(time|monotonic|perf_counter|process_time)"
+    r"|random\.\w+"
+    r"|np\.random\.\w+|numpy\.random\.\w+"
+    r"|datetime\.(datetime\.)?now)$"
+)
+_SYNC_ATTRS = {"device_get", "device_put", "block_until_ready"}
+_TRACE_WRAPPERS = {
+    "jit", "shard_map", "_shard_map", "pmap", "vmap", "make_jaxpr",
+    "eval_shape", "scan", "while_loop", "cond", "switch", "checkpoint",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    text: str  # stripped source line (the baseline key component)
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}|{self.path}|{self.text}"
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """`a.b.c` -> "a.b.c"; None for anything not a pure name chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _jax_aliases(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(module aliases bound to jax/jax.*, aliases bound to jax.numpy)."""
+    jax_mods: set[str] = set()
+    jnp_mods: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name, alias = a.name, a.asname or a.name.split(".")[0]
+                if name == "jax.numpy":
+                    jnp_mods.add(alias)
+                elif name == "jax" or name.startswith("jax."):
+                    jax_mods.add(alias)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                alias = a.asname or a.name
+                if node.module == "jax" and a.name == "numpy":
+                    jnp_mods.add(alias)
+                elif node.module == "jax" and a.name == "lax":
+                    jax_mods.add(alias)
+    return jax_mods, jnp_mods
+
+
+# jax.* second components that never dispatch a device program (config,
+# tree registration, lazily-compiled wrappers).  jax.jit/shard_map AT
+# IMPORT only builds a wrapper; tracing happens at first call.
+_GL001_SAFE_SECOND = {
+    "config", "tree_util", "util", "typing", "custom_jvp", "custom_vjp",
+    "jit", "shard_map", "named_scope", "debug",
+}
+
+
+def _import_time_calls(tree: ast.Module):
+    """Calls evaluated at import: module/class bodies plus function
+    decorators and default-argument expressions; function BODIES are
+    pruned (ast.walk cannot prune, hence the explicit stack)."""
+    stack: list[ast.AST] = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(node.decorator_list)
+            stack.extend(node.args.defaults)
+            stack.extend(d for d in node.args.kw_defaults if d is not None)
+            continue
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _calls_in(node: ast.AST):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def _contains_traced_call(node: ast.AST, jax_mods, jnp_mods) -> str | None:
+    """A call on a jnp/lax chain inside ``node``, or None."""
+    for call in ast.walk(node):
+        if not isinstance(call, ast.Call):
+            continue
+        d = _dotted(call.func)
+        if d is None:
+            continue
+        root = d.split(".")[0]
+        if root in jnp_mods:
+            return d
+        if root in jax_mods and (".lax." in d or d.startswith("lax.")):
+            return d
+    return None
+
+
+def _traced_function_names(tree: ast.Module) -> set[str]:
+    """Names of functions that get traced: jit/shard_map-decorated, or
+    passed (as a name or ``self.attr``) into a trace-wrapper call."""
+    traced: set[str] = set()
+
+    def collect_callables(node: ast.AST):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                traced.add(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                traced.add(sub.attr)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                d = _dotted(dec if not isinstance(dec, ast.Call) else dec.func)
+                if d and d.split(".")[-1] in ("jit", "shard_map", "pmap"):
+                    traced.add(node.name)
+                if isinstance(dec, ast.Call) and _dotted(dec.func) in (
+                    "functools.partial", "partial"
+                ):
+                    for a in dec.args[:1]:
+                        da = _dotted(a)
+                        if da and da.split(".")[-1] in ("jit", "shard_map"):
+                            traced.add(node.name)
+        elif isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d and d.split(".")[-1] in _TRACE_WRAPPERS:
+                for a in node.args:
+                    collect_callables(a)
+    return traced
+
+
+def _function_defs(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+class _Linter:
+    def __init__(self, src: str, path: str, relpath: str):
+        self.src = src
+        self.lines = src.splitlines()
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.tree = ast.parse(src, filename=path)
+        self.jax_mods, self.jnp_mods = _jax_aliases(self.tree)
+        self.findings: list[Finding] = []
+
+    def add(self, rule: str, node: ast.AST, message: str):
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        text = self.lines[line - 1].strip() if line <= len(self.lines) else ""
+        self.findings.append(
+            Finding(rule, self.relpath, line, col, message, text)
+        )
+
+    # -- rules -----------------------------------------------------------
+
+    def gl001_import_time_dispatch(self):
+        for call in _import_time_calls(self.tree):
+            d = _dotted(call.func)
+            if d is None:
+                continue
+            parts = d.split(".")
+            root = parts[0]
+            if root in self.jnp_mods:
+                self.add(
+                    "GL001", call,
+                    f"`{d}(...)` at module import time forces XLA "
+                    "client creation (aborts pytest collection on "
+                    "backend-less hosts) — use numpy scalars/arrays "
+                    "at module scope",
+                )
+            elif root in self.jax_mods and root == "jax":
+                if len(parts) > 1 and parts[1] in _GL001_SAFE_SECOND:
+                    continue
+                self.add(
+                    "GL001", call,
+                    f"`{d}(...)` at module import time touches the "
+                    "backend — move it inside a function",
+                )
+
+    def gl002_impure_in_traced(self, traced: set[str]):
+        for fn in _function_defs(self.tree):
+            if fn.name not in traced:
+                continue
+            for call in _calls_in(fn):
+                d = _dotted(call.func)
+                if d and _IMPURE_CALLS.match(d):
+                    self.add(
+                        "GL002", call,
+                        f"`{d}()` inside traced `{fn.name}` is baked in "
+                        "as a compile-time constant (and silently "
+                        "frozen across retraces)",
+                    )
+
+    def gl003_broad_except(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                self.add("GL003", node, "bare `except:` swallows "
+                         "KeyboardInterrupt/SystemExit — name the "
+                         "exceptions")
+                continue
+            types = (
+                node.type.elts if isinstance(node.type, ast.Tuple)
+                else [node.type]
+            )
+            for t in types:
+                d = _dotted(t)
+                if d in ("Exception", "BaseException"):
+                    self.add(
+                        "GL003", node,
+                        f"blanket `except {d}` hides unrelated bugs — "
+                        "narrow it or waive with the justification",
+                    )
+                    break
+
+    def gl004_traced_branch(self, traced: set[str]):
+        for fn in _function_defs(self.tree):
+            if fn.name not in traced:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    d = _contains_traced_call(
+                        node.test, self.jax_mods, self.jnp_mods
+                    )
+                    if d:
+                        self.add(
+                            "GL004", node,
+                            f"Python branch on traced value (`{d}` in "
+                            f"the test) inside traced `{fn.name}` — "
+                            "this is a TracerBoolConversionError at "
+                            "best, a silent trace-time constant at "
+                            "worst; use lax.cond/jnp.where",
+                        )
+                elif isinstance(node, ast.Call):
+                    dd = _dotted(node.func)
+                    if dd == "bool" and node.args and _contains_traced_call(
+                        node.args[0], self.jax_mods, self.jnp_mods
+                    ):
+                        self.add(
+                            "GL004", node,
+                            f"`bool(...)` of a traced expression inside "
+                            f"traced `{fn.name}`",
+                        )
+
+    def gl005_narrow_offset(self):
+        if not any(d in os.path.dirname(self.relpath.replace("/", os.sep))
+                   or self.relpath.replace("/", os.sep).startswith(d)
+                   for d in WIDTH_DIRS):
+            return
+
+        def is_i32(node: ast.AST) -> bool:
+            d = _dotted(node)
+            if d is None:
+                return isinstance(node, ast.Constant) and node.value == "int32"
+            last = d.split(".")[-1]
+            return last in _I32_NAMES
+
+        for node in ast.walk(self.tree):
+            # x.astype(I32) / np.int32(expr) where the expression or its
+            # assignment target smells like row/offset arithmetic
+            expr_src = None
+            call = None
+            if isinstance(node, ast.Assign):
+                targets = "/".join(
+                    filter(None, (_dotted(t) for t in node.targets))
+                )
+                for c in _calls_in(node.value):
+                    if (
+                        isinstance(c.func, ast.Attribute)
+                        and c.func.attr == "astype"
+                        and c.args and is_i32(c.args[0])
+                    ) or (
+                        _dotted(c.func) is not None
+                        and _dotted(c.func).split(".")[-1] in _I32_NAMES
+                    ):
+                        try:
+                            expr_src = targets + "=" + ast.unparse(node.value)
+                        except Exception:  # graftlint: waive[GL003]
+                            expr_src = targets
+                        call = c
+                        break
+            if call is None or expr_src is None:
+                continue
+            if _OFFSET_NAME_RE.search(expr_src):
+                self.add(
+                    "GL005", call,
+                    "i32 cast on row/offset arithmetic — i32 offsets "
+                    "wrap past 2^32 rows (the PR 1 incident class); "
+                    "keep row/offset math in i64, or waive with the "
+                    "proven bound",
+                )
+
+        # cumsum accumulating into i32 wraps at 2 GB packed streams
+        # regardless of variable naming (parallel/exchange.py's offsets)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d and d.split(".")[-1] == "cumsum":
+                    for kw in node.keywords:
+                        if kw.arg == "dtype" and is_i32(kw.value):
+                            self.add(
+                                "GL005", node,
+                                "i32 cumsum — offset accumulators wrap "
+                                "once a packed stream passes 2 GB",
+                            )
+
+    def gl006_host_sync_ledger(self):
+        rel_os = self.relpath.replace("/", os.sep)
+        if not any(rel_os.endswith(s) for s in HOT_LOOP_SUFFIXES):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            attr = d.split(".")[-1] if d else (
+                node.func.attr if isinstance(node.func, ast.Attribute)
+                else None
+            )
+            if attr in _SYNC_ATTRS:
+                self.add(
+                    "GL006", node,
+                    f"host-sync call `{attr}` in a hot-loop module — "
+                    "every sync stalls the dispatch pipeline; new sites "
+                    "must be baselined (python -m tla_raft_tpu.analysis "
+                    "--write-baseline) or waived",
+                )
+
+    def gl007_worker_device_dispatch(self):
+        # local function defs by name (module + class scope)
+        defs = {fn.name: fn for fn in _function_defs(self.tree)}
+        # names bound to an executor constructor — `with TPE(...) as ex:`
+        # and `x = TPE(...)` — so the rule is not fooled by variable
+        # naming (the repo's own `as ex:` idiom in native/insert_sharded)
+        bound: set[str] = set()
+
+        def ctor(call: ast.AST) -> bool:
+            if not isinstance(call, ast.Call):
+                return False
+            d = _dotted(call.func)
+            return bool(d) and d.split(".")[-1] in (
+                "ThreadPoolExecutor", "ProcessPoolExecutor",
+            )
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if ctor(item.context_expr) and isinstance(
+                        item.optional_vars, ast.Name
+                    ):
+                        bound.add(item.optional_vars.id)
+            elif isinstance(node, ast.Assign) and ctor(node.value):
+                for t in node.targets:
+                    d = _dotted(t)
+                    if d:
+                        bound.add(d.split(".")[-1])
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in ("submit", "map"):
+                continue
+            owner = _dotted(node.func.value) or ""
+            if not (
+                re.search(r"pool|executor", owner, re.IGNORECASE)
+                or owner.split(".")[-1] in bound
+            ):
+                continue
+            if not node.args:
+                continue
+            target = node.args[0]
+            tname = None
+            if isinstance(target, ast.Name):
+                tname = target.id
+            elif isinstance(target, ast.Attribute):
+                tname = target.attr
+            fn = defs.get(tname)
+            if fn is None:
+                continue
+            for sub in ast.walk(fn):
+                d = None
+                if isinstance(sub, ast.Name):
+                    d = sub.id
+                if d in self.jax_mods or d in self.jnp_mods:
+                    self.add(
+                        "GL007", node,
+                        f"`{tname}` is handed to thread pool "
+                        f"`{owner}` but references `{d}` — worker "
+                        "threads must never dispatch device programs "
+                        "(concurrent collectives deadlock the mesh "
+                        "rendezvous; see parallel/sharded.py _io_pool)",
+                    )
+                    break
+
+    def gl008_unused_import(self):
+        if os.path.basename(self.relpath) == "__init__.py":
+            return  # re-export surface
+        imported: dict[str, ast.AST] = {}
+        for node in self.tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name.split(".")[0]
+                    imported[name] = node
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    name = a.asname or a.name
+                    imported[name] = node
+        used: set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                pass  # roots are Names, already collected
+        for name, node in imported.items():
+            if name.startswith("_"):
+                continue
+            if name not in used:
+                line = self.lines[node.lineno - 1]
+                if "noqa" in line:
+                    continue
+                self.add(
+                    "GL008", node,
+                    f"imported name `{name}` is never used",
+                )
+
+    # -- driver ----------------------------------------------------------
+
+    def run(self, select: set[str] | None = None) -> list[Finding]:
+        traced = _traced_function_names(self.tree)
+        rules = {
+            "GL001": self.gl001_import_time_dispatch,
+            "GL002": lambda: self.gl002_impure_in_traced(traced),
+            "GL003": self.gl003_broad_except,
+            "GL004": lambda: self.gl004_traced_branch(traced),
+            "GL005": self.gl005_narrow_offset,
+            "GL006": self.gl006_host_sync_ledger,
+            "GL007": self.gl007_worker_device_dispatch,
+            "GL008": self.gl008_unused_import,
+        }
+        for rule, fn in rules.items():
+            if select is None or rule in select:
+                fn()
+        return self._apply_waivers(self.findings)
+
+    def _apply_waivers(self, findings: list[Finding]) -> list[Finding]:
+        waivers: dict[int, set[str]] = {}
+        comment_only: set[int] = set()
+        for i, line in enumerate(self.lines, start=1):
+            m = _WAIVE_RE.search(line)
+            if m:
+                waivers[i] = {t.strip() for t in m.group(1).split(",")}
+                if line.strip().startswith("#"):
+                    comment_only.add(i)
+        if not waivers:
+            return findings
+
+        def waived(f: Finding) -> bool:
+            # same-line waiver, or a COMMENT-ONLY waiver line directly
+            # above (a code line's trailing waiver covers that line only)
+            rules = waivers.get(f.line)
+            if rules and (f.rule in rules or "*" in rules):
+                return True
+            if f.line - 1 in comment_only:
+                rules = waivers[f.line - 1]
+                return f.rule in rules or "*" in rules
+            return False
+
+        return [f for f in findings if not waived(f)]
+
+
+def lint_source(
+    src: str, path: str = "<string>", relpath: str | None = None,
+    select: set[str] | None = None,
+) -> list[Finding]:
+    """Lint one module's source; waivers applied, baseline NOT applied."""
+    return _Linter(src, path, relpath or path).run(select)
+
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git")
+                ]
+                out.extend(
+                    os.path.join(dirpath, f)
+                    for f in filenames if f.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            out.append(p)
+    return sorted(out)
+
+
+def lint_paths(
+    paths: list[str], root: str | None = None,
+    select: set[str] | None = None,
+) -> list[Finding]:
+    """Lint files/trees; paths in findings are relative to ``root``
+    (default: the repo root inferred as the parent of this package)."""
+    if root is None:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    findings: list[Finding] = []
+    for f in iter_py_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        rel = os.path.relpath(os.path.abspath(f), root)
+        findings.extend(lint_source(src, f, rel, select))
+    return findings
+
+
+# -- baseline -------------------------------------------------------------
+
+def load_baseline(path: str = BASELINE_PATH) -> dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return dict(data.get("entries", {}))
+
+
+def write_baseline(findings: list[Finding], path: str = BASELINE_PATH):
+    entries: dict[str, int] = {}
+    for f in findings:
+        entries[f.key] = entries.get(f.key, 0) + 1
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(
+            {
+                "comment": "graftlint baseline: pinned inventory of "
+                           "accepted findings (rule|path|line-text -> "
+                           "count). Regenerate deliberately with "
+                           "`python -m tla_raft_tpu.analysis "
+                           "--write-baseline` and review the diff.",
+                "version": 1,
+                "entries": dict(sorted(entries.items())),
+            },
+            fh, indent=1,
+        )
+        fh.write("\n")
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict[str, int]
+) -> tuple[list[Finding], int]:
+    """Subtract baselined findings; returns (unwaived, n_suppressed)."""
+    budget = dict(baseline)
+    kept: list[Finding] = []
+    suppressed = 0
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            suppressed += 1
+        else:
+            kept.append(f)
+    return kept, suppressed

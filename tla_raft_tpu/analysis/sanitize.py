@@ -1,0 +1,379 @@
+"""graftlint layer 3: runtime sanitizer for check runs (GRAFT_SANITIZE=1).
+
+Three runtime ledgers the static layers cannot see:
+
+* **host-transfer ledger** — explicit ``jax.device_get``/``device_put``
+  are wrapped to count calls and bytes (the *intended* syncs); implicit
+  device->host conversions (``bool()``/``int()``/``float()``/
+  ``np.asarray`` on a device array — the *accidental* syncs that stall
+  the dispatch pipeline mid-level) raise at the offending site (strict,
+  default) or are counted (GRAFT_SANITIZE_STRICT=0).  ``jax``'s own
+  ``transfer_guard`` is also armed, but it is a no-op on the CPU
+  backend (host arrays are zero-copy), so the dunder interception is
+  what makes the guarantee portable to the virtual-mesh CI.
+* **compile-count ledger** — every XLA backend compile is counted via
+  the jax monitoring events.  The engines tick the sanitizer once per
+  BFS level and declare shape events (capacity growth, presize, new
+  program shapes); a compile in a post-warmup level with NO declared
+  shape event is a violation — that is precisely the "one silent
+  retrace per level erases the kernel wins" regression class.
+* **dispatch-thread guard** — worker threads marked by
+  :func:`forbid_device_dispatch_in_thread` (the sharded checker's
+  ``_io_pool``/``_ck_pool`` initializers do this unconditionally) must
+  never reach a device dispatch: concurrently dispatched collectives
+  interleave differently across devices and deadlock the mesh
+  rendezvous (the PR 1 deep-tail incident).  The marking is always on
+  and costs one thread-local read; under the sanitizer the wrapped
+  ``device_get``/``device_put`` also assert it.
+
+Module import is stdlib-only (device-free import contract); jax is
+imported lazily when a :class:`Sanitizer` is entered.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_tl = threading.local()
+
+# the active sanitizer (None = every hook below is a cheap no-op)
+CURRENT: "Sanitizer | None" = None
+
+
+# -- always-on dispatch-thread guard --------------------------------------
+
+def forbid_device_dispatch_in_thread() -> None:
+    """Mark the CURRENT thread as never-dispatching (pool initializer)."""
+    _tl.no_dispatch = True
+
+
+def device_dispatch_forbidden() -> bool:
+    return getattr(_tl, "no_dispatch", False)
+
+
+def assert_device_dispatch_ok(what: str = "device dispatch") -> None:
+    """Raise if called from a thread marked no-dispatch.
+
+    Cheap enough to be always on (one thread-local read): guards the
+    program-dispatch helpers of parallel/sharded.py against a worker
+    thread ever launching a device program."""
+    if getattr(_tl, "no_dispatch", False):
+        if CURRENT is not None:
+            CURRENT.n_worker_dispatch += 1
+        raise RuntimeError(
+            f"graftlint: {what} from worker thread "
+            f"{threading.current_thread().name!r} — worker threads must "
+            "never launch device programs (concurrent collectives "
+            "deadlock the mesh rendezvous; do the dispatch on the main "
+            "thread and hand workers numpy buffers)"
+        )
+
+
+# -- engine hooks (no-ops unless a Sanitizer is active) -------------------
+
+def level_tick() -> None:
+    """Engines call this once per completed BFS level."""
+    if CURRENT is not None:
+        CURRENT.level_tick()
+
+
+def note_shape_event(reason: str) -> None:
+    """Engines declare legitimate recompile causes (capacity growth,
+    presize, a new program shape) for the level in flight."""
+    if CURRENT is not None:
+        CURRENT.note_shape_event(reason)
+
+
+_UNSET = object()
+
+
+class _AllowTransfers:
+    """Reentrant thread-local allowance for the wrapped explicit paths."""
+
+    def __enter__(self):
+        _tl.allow = getattr(_tl, "allow", 0) + 1
+
+    def __exit__(self, *exc):
+        _tl.allow -= 1
+
+
+def _allowed() -> bool:
+    return getattr(_tl, "allow", 0) > 0
+
+
+class Sanitizer:
+    """Context manager wrapping one check run.  See module docstring."""
+
+    def __init__(self, warmup_levels: int | None = None,
+                 strict: bool | None = None):
+        if warmup_levels is None:
+            warmup_levels = int(os.environ.get("GRAFT_SANITIZE_WARMUP", "2"))
+        if strict is None:
+            strict = os.environ.get("GRAFT_SANITIZE_STRICT", "1") == "1"
+        self.warmup_levels = warmup_levels
+        self.strict = strict
+        self.level = 0
+        self.compiles_total = 0
+        self._level_compiles = 0
+        self._level_events: list[str] = []
+        self._grace = 0
+        self.n_ledgered_get = 0
+        self.n_ledgered_put = 0
+        self.ledgered_bytes = 0
+        self.n_implicit = 0
+        self.n_worker_dispatch = 0
+        self.violations: list[str] = []
+        self._patches: list[tuple[object, str, object]] = []
+        self._listener = None
+        self._active = False
+        self._tg_prev = _UNSET  # the guard's default is None — a real value
+        # GRAFT_SANITIZE_DEBUG=1: capture the NAMES of compiled programs
+        # per level (via jax_log_compiles) so a flagged retrace says
+        # which program retraced, not just that one did
+        self.debug = os.environ.get("GRAFT_SANITIZE_DEBUG") == "1"
+        self._level_names: list[str] = []
+        self._log_handler = None
+
+    # -- wiring ----------------------------------------------------------
+
+    def __enter__(self):
+        global CURRENT
+        if CURRENT is not None:
+            raise RuntimeError("a Sanitizer is already active")
+        try:
+            return self._arm()
+        except BaseException:  # graftlint: waive[GL003] — unwind + re-raise
+            # private jax APIs (monitoring, ArrayImpl dunders) can move
+            # across releases: a partially-armed sanitizer must unwind
+            # fully or every retry would see stale patches / CURRENT
+            self._disarm()
+            raise
+
+    def _arm(self):
+        global CURRENT
+        import jax
+        from jax._src import monitoring
+        from jax._src.array import ArrayImpl
+
+        def on_event(name, *a, **kw):
+            if self._active and name == (
+                "/jax/core/compile/backend_compile_duration"
+            ):
+                self.compiles_total += 1
+                self._level_compiles += 1
+
+        self._listener = on_event
+        monitoring.register_event_duration_secs_listener(on_event)
+
+        if self.debug:
+            import logging
+
+            class _H(logging.Handler):
+                def emit(h, record):  # noqa: N805
+                    msg = record.getMessage()
+                    if self._active and msg.startswith("Compiling "):
+                        self._level_names.append(msg.split()[1])
+
+            self._log_prev = jax.config.jax_log_compiles
+            jax.config.update("jax_log_compiles", True)
+            self._log_handler = _H()
+            logging.getLogger("jax").addHandler(self._log_handler)
+
+        san = self
+
+        def _patch(obj, name, repl):
+            self._patches.append((obj, name, getattr(obj, name)))
+            setattr(obj, name, repl)
+
+        orig_get, orig_put = jax.device_get, jax.device_put
+
+        def device_get(x, *a, **kw):
+            assert_device_dispatch_ok("jax.device_get")
+            with _AllowTransfers():
+                out = orig_get(x, *a, **kw)
+            san.n_ledgered_get += 1
+            san.ledgered_bytes += _nbytes(out)
+            return out
+
+        def device_put(x, *a, **kw):
+            assert_device_dispatch_ok("jax.device_put")
+            with _AllowTransfers():
+                out = orig_put(x, *a, **kw)
+            san.n_ledgered_put += 1
+            return out
+
+        _patch(jax, "device_get", device_get)
+        _patch(jax, "device_put", device_put)
+
+        def conv_wrapper(name, orig):
+            def wrapped(self_arr, *a, **kw):
+                if san._active and not _allowed():
+                    san.n_implicit += 1
+                    if san.strict:
+                        raise RuntimeError(
+                            f"graftlint: unledgered implicit host "
+                            f"transfer ({name} on a device array of "
+                            f"shape {getattr(self_arr, 'shape', '?')}) "
+                            "— use jax.device_get at an intended sync "
+                            "point, or set GRAFT_SANITIZE_STRICT=0 to "
+                            "count instead of raise"
+                        )
+                return orig(self_arr, *a, **kw)
+            return wrapped
+
+        for name in ("__array__", "__bool__", "__int__", "__float__",
+                     "__index__"):
+            orig = getattr(ArrayImpl, name, None)
+            if orig is not None:
+                _patch(ArrayImpl, name, conv_wrapper(name, orig))
+
+        # arm jax's own guard too: free on CPU (zero-copy, never fires),
+        # real coverage of np.asarray paths on accelerator backends
+        self._tg_prev = jax.config.jax_transfer_guard_device_to_host
+        jax.config.update(
+            "jax_transfer_guard_device_to_host",
+            "disallow" if self.strict else "log",
+        )
+        self._active = True
+        CURRENT = self  # last: everything fallible is armed by now
+        return self
+
+    def _disarm(self):
+        global CURRENT
+        import jax
+        from jax._src import monitoring
+
+        self._active = False
+        for obj, name, orig in reversed(self._patches):
+            setattr(obj, name, orig)
+        self._patches.clear()
+        if self._tg_prev is not _UNSET:
+            jax.config.update(
+                "jax_transfer_guard_device_to_host", self._tg_prev
+            )
+            self._tg_prev = _UNSET
+        if self._log_handler is not None:
+            import logging
+
+            logging.getLogger("jax").removeHandler(self._log_handler)
+            jax.config.update("jax_log_compiles", self._log_prev)
+            self._log_handler = None
+        if self._listener is not None:
+            try:
+                monitoring._unregister_event_duration_listener_by_callback(
+                    self._listener
+                )
+            except (AttributeError, ValueError):
+                # listener API drift across jax versions: a stale
+                # listener is inert anyway (gated on self._active)
+                pass
+            self._listener = None
+        CURRENT = None
+
+    def __exit__(self, *exc):
+        # close the final (partial) level's accounting
+        if self._level_compiles:
+            self.level_tick()
+        self._disarm()
+        return False
+
+    # -- per-level accounting --------------------------------------------
+
+    def note_shape_event(self, reason: str) -> None:
+        self._level_events.append(reason)
+
+    def level_tick(self) -> None:
+        self.level += 1
+        excused = bool(self._level_events) or self._grace > 0
+        # a shape event declared in level N excuses level N+1 as well:
+        # engines observe shape changes at level END (the new frontier/
+        # store widths), while the programs built against those widths
+        # first compile early in the NEXT level
+        if self._level_events:
+            self._grace = 1
+        elif self._grace:
+            self._grace -= 1
+        if (
+            self.level > self.warmup_levels
+            and self._level_compiles > 0
+            and not excused
+        ):
+            names = (
+                f" ({', '.join(self._level_names)})"
+                if self._level_names else ""
+            )
+            self.violations.append(
+                f"level {self.level}: {self._level_compiles} XLA "
+                f"compile(s) with no declared shape event{names} — a "
+                "silent retrace in the steady-state level loop"
+            )
+        self._level_compiles = 0
+        self._level_events = []
+        self._level_names = []
+
+    # -- reporting -------------------------------------------------------
+
+    @property
+    def ok(self) -> bool:
+        return (
+            not self.violations
+            and self.n_implicit == 0
+            and self.n_worker_dispatch == 0
+        )
+
+    def report(self) -> dict:
+        return dict(
+            ok=self.ok,
+            levels=self.level,
+            warmup_levels=self.warmup_levels,
+            compiles_total=self.compiles_total,
+            unexpected_recompiles=len(self.violations),
+            ledgered_device_get=self.n_ledgered_get,
+            ledgered_device_put=self.n_ledgered_put,
+            ledgered_bytes=self.ledgered_bytes,
+            unledgered_transfers=self.n_implicit,
+            worker_thread_dispatches=self.n_worker_dispatch,
+            violations=list(self.violations),
+        )
+
+    def print_report(self, out) -> None:
+        r = self.report()
+        print(
+            f"Sanitizer: {r['compiles_total']} XLA compiles over "
+            f"{r['levels']} levels (warmup {r['warmup_levels']}), "
+            f"{r['unexpected_recompiles']} post-warmup unexpected "
+            "recompiles.",
+            file=out,
+        )
+        print(
+            f"Sanitizer: {r['ledgered_device_get']} ledgered fetches / "
+            f"{r['ledgered_device_put']} puts "
+            f"({r['ledgered_bytes']:,} B), "
+            f"{r['unledgered_transfers']} unledgered host transfers, "
+            f"{r['worker_thread_dispatches']} worker-thread device "
+            "dispatches.",
+            file=out,
+        )
+        for v in r["violations"]:
+            print(f"Sanitizer: VIOLATION — {v}", file=out)
+        print(
+            "Sanitizer: OK" if r["ok"] else "Sanitizer: FAIL",
+            file=out,
+        )
+
+
+def _nbytes(tree) -> int:
+    total = 0
+    stack = [tree]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, dict):
+            stack.extend(x.values())
+        elif isinstance(x, (list, tuple)):
+            stack.extend(x)
+        elif hasattr(x, "_fields"):  # NamedTuple
+            stack.extend(tuple(x))
+        else:
+            total += int(getattr(x, "nbytes", 0) or 0)
+    return total

@@ -26,6 +26,7 @@ with no off switch, matching the pinned ``-deadlock`` flag.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import json
 import os
@@ -225,6 +226,7 @@ def main(argv=None) -> int:
             return 2
         print(f"Spec {spec_path}: structure matches compiled semantics.", file=out)
 
+    sanitizer = None
     if args.backend == "oracle":
         from .oracle import OracleChecker
 
@@ -235,6 +237,19 @@ def main(argv=None) -> int:
         jax = setup_jax()
 
         from .engine import JaxChecker
+
+        if os.environ.get("GRAFT_SANITIZE") == "1":
+            # graftlint layer 3 (docs/ANALYSIS.md): host-transfer ledger
+            # + per-level compile-count ledger + dispatch-thread guard
+            from .analysis.sanitize import Sanitizer
+
+            sanitizer = Sanitizer()
+            print(
+                f"Sanitizer: armed (warmup {sanitizer.warmup_levels} "
+                f"levels, {'strict' if sanitizer.strict else 'counting'} "
+                "transfer guard)",
+                file=out,
+            )
 
         print(f"Devices: {jax.devices()}", file=out)
 
@@ -259,6 +274,9 @@ def main(argv=None) -> int:
                 host_store.clear()
             print(f"Native FP store: {args.fpstore_dir}", file=out)
 
+        sanctx = sanitizer if sanitizer is not None else (
+            contextlib.nullcontext()
+        )
         if args.mesh:
             if args.mesh_deep and not args.fpstore_dir:
                 print("--mesh-deep requires --fpstore-dir (the sharded "
@@ -279,12 +297,13 @@ def main(argv=None) -> int:
                 deep=args.mesh_deep, seg_rows=args.seg_rows,
                 sieve=not args.no_sieve, compress=not args.no_compress,
             )
-            res = chk.run(
-                max_depth=args.max_depth,
-                checkpoint_dir=args.checkpoint_dir,
-                checkpoint_every=args.checkpoint_every,
-                resume_from=args.recover,
-            )
+            with sanctx:
+                res = chk.run(
+                    max_depth=args.max_depth,
+                    checkpoint_dir=args.checkpoint_dir,
+                    checkpoint_every=args.checkpoint_every,
+                    resume_from=args.recover,
+                )
             if args.mesh_deep and chk.meter.levels:
                 # run-summary exchange ledger: the sieve+compress bytes
                 # vs what the uncompressed exchange would have moved
@@ -306,18 +325,21 @@ def main(argv=None) -> int:
                         file=out,
                     )
         else:
-            res = JaxChecker(
-                cfg, chunk=args.chunk, progress=progress,
-                host_store=host_store, canon=args.canon,
-            ).run(
-                max_depth=args.max_depth,
-                checkpoint_dir=args.checkpoint_dir,
-                checkpoint_every=args.checkpoint_every,
-                resume_from=args.recover,
-            )
+            with sanctx:
+                res = JaxChecker(
+                    cfg, chunk=args.chunk, progress=progress,
+                    host_store=host_store, canon=args.canon,
+                ).run(
+                    max_depth=args.max_depth,
+                    checkpoint_dir=args.checkpoint_dir,
+                    checkpoint_every=args.checkpoint_every,
+                    resume_from=args.recover,
+                )
 
     dt = time.monotonic() - t0
     print(file=out)
+    if sanitizer is not None:
+        sanitizer.print_report(out)
     if res.ok:
         print("Model checking completed. No error has been found.", file=out)
     else:
@@ -359,6 +381,10 @@ def main(argv=None) -> int:
         )
     if logf:
         logf.close()
+    if res.ok and sanitizer is not None and not sanitizer.ok:
+        # sanitizer findings on an otherwise-clean run: distinct exit
+        # code so CI can tell "model violation" from "runtime hygiene"
+        return 3
     return 0 if res.ok else 1
 
 

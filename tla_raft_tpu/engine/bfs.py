@@ -58,12 +58,14 @@ import functools
 import os
 import sys
 import time
+import zipfile
 from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import sanitize as graft_sanitize
 from ..config import RaftConfig
 from ..models.raft import RaftState, init_batch, to_oracle
 from ..ops.successor import SuccessorKernel, get_kernel
@@ -211,7 +213,9 @@ def _is_tunneled() -> bool:
         return "axon" in str(
             getattr(jax.extend.backend.get_backend(), "platform_version", "")
         )
-    except Exception:
+    except Exception:  # graftlint: waive[GL003] — any backend-probe
+        # failure (missing module, no devices, RPC error) means "not
+        # tunneled"; the probe must never take the checker down
         return False
 
 
@@ -865,7 +869,8 @@ class JaxChecker:
         """Identify which invariant a known-bad state violates (cold path)."""
         one = jax.tree.map(lambda x: x[idx : idx + 1], children)
         for name, fn in self.inv_fns:
-            if not bool(np.asarray(fn(self.cfg, one, self.kern.tables))[0]):
+            ok = jax.device_get(fn(self.cfg, one, self.kern.tables))
+            if not bool(np.asarray(ok)[0]):
                 return name
         return self.inv_fns[0][0]
 
@@ -1713,6 +1718,9 @@ class JaxChecker:
             lvs.append(jnp.full((pad,), SENT, U64))
             lfs.append(jnp.full((pad,), SENT, U64))
             lps.append(jnp.full((pad,), -1, I64))
+        # the level-dedup sort shape: part of the sanitizer's per-level
+        # shape signature (a new lane count legitimately recompiles it)
+        self._san_lanes = n_lanes + pad
         n_new_dev, new_fps, new_payload = _level_dedup(
             jnp.concatenate(lvs), jnp.concatenate(lfs), jnp.concatenate(lps),
             visited,
@@ -1936,8 +1944,11 @@ class JaxChecker:
                     hv=z["hv"], hf=z["hf"], hp=z["hp"],
                     mult=z["mult"].astype(np.int64),
                 )
-            except Exception:
-                os.unlink(f)  # truncated by a crash mid-write
+            except (OSError, ValueError, KeyError, EOFError,
+                    zipfile.BadZipFile):
+                # crash-truncated partial: the zip layer raises any of
+                # these depending on where the write stopped
+                os.unlink(f)
                 continue
             out[meta[1]] = rec
         return out
@@ -2062,7 +2073,9 @@ class JaxChecker:
             trace_levels = []
             mult_per_slot = np.zeros(K, np.int64)
 
-            bad0 = int(np.asarray(self._inv_scan(st0, jnp.asarray(1, I64))))
+            bad0 = int(
+                jax.device_get(self._inv_scan(st0, jnp.asarray(1, I64)))
+            )
             if bad0 >= 0:
                 name0 = self._bad_invariant_name(st0, bad0)
                 return CheckResult(
@@ -2073,7 +2086,7 @@ class JaxChecker:
                     ),
                 )
             frontier, ovf0 = jax.jit(self._deflate)(st0)
-            if bool(ovf0.any()):
+            if bool(jax.device_get(ovf0.any())):
                 raise RuntimeError(
                     f"initial state's message set exceeds cap_m={self.cap_m}"
                 )
@@ -2237,6 +2250,26 @@ class JaxChecker:
                         elapsed=time.monotonic() - t0,
                     )
                 )
+            if graft_sanitize.CURRENT is not None:
+                # per-level shape signature: a compile in a level whose
+                # signature matches the previous level's is a SILENT
+                # retrace (the regression class the sanitizer exists to
+                # catch); any signature change is a declared shape event
+                if isinstance(frontier, list):
+                    fcap = tuple(_seg_rows(s) for s in frontier)
+                else:
+                    fcap = frontier.voted_for.shape[0]
+                sig = (
+                    fcap,
+                    0 if self.host_store is not None else visited.shape[0],
+                    int(new_payload.shape[0]),
+                    self.cap_x, self.cap_g, self.cap_m,
+                    getattr(self, "_san_lanes", 0),
+                )
+                if sig != getattr(self, "_san_sig", None):
+                    graft_sanitize.note_shape_event(f"level shapes {sig}")
+                    self._san_sig = sig
+                graft_sanitize.level_tick()
             if bad_idx >= 0:
                 if isinstance(frontier, list):
                     L0 = _seg_rows(frontier[0])

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import FOLLOWER, RaftConfig
-from ..ops.msg_universe import MsgUniverse, get_universe
+from ..ops.msg_universe import get_universe
 
 
 class RaftState(NamedTuple):

@@ -169,7 +169,8 @@ class HostFPStore:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # graftlint: waive[GL003] — a destructor at
+            # interpreter teardown must never raise, whatever the cause
             pass
 
 
@@ -190,5 +191,10 @@ def insert_sharded(stores: list, fps: np.ndarray) -> int:
     def one(o):
         return int(stores[o].insert(shares[o]).sum()) if len(shares[o]) else 0
 
-    with ThreadPoolExecutor(max_workers=min(D, os.cpu_count() or 2)) as ex:
+    from ..analysis.sanitize import forbid_device_dispatch_in_thread
+
+    with ThreadPoolExecutor(
+        max_workers=min(D, os.cpu_count() or 2),
+        initializer=forbid_device_dispatch_in_thread,
+    ) as ex:
         return sum(ex.map(one, range(D)))

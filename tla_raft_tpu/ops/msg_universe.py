@@ -28,7 +28,6 @@ Raft.tla:111,116), prevLogTerm in 0..T, entries carry at most ONE entry
 from __future__ import annotations
 
 import functools
-import itertools
 
 import numpy as np
 

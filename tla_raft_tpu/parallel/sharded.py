@@ -45,6 +45,7 @@ import functools
 import os
 import sys
 import time
+from types import SimpleNamespace
 from typing import NamedTuple
 
 import jax
@@ -52,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..analysis import sanitize as graft_sanitize
 from ..config import RaftConfig
 from ..engine.bfs import _compact_payloads
 from ..engine.invariants import resolve_invariant_kernel
@@ -361,6 +363,7 @@ class ShardedChecker:
             cp_raw, lane, overflow = _compact_payloads(
                 valid.ravel(), payload, self.cap_x
             )
+            # graftlint: waive[GL005] — device-local row, < cap_f <= 2^31
             lidx = ((cp_raw // K) % cap_f).astype(I32)
             parents = jax.tree.map(lambda x: x[lidx], frontier)
             children = self.kern.materialize(parents, cp_raw % K)
@@ -787,12 +790,10 @@ class ShardedChecker:
         host filter through the per-owner external stores, phase 2
         (verdicts back + materialize).  Returns a LevelOut-shaped
         namespace for the shared driver loop."""
-        from types import SimpleNamespace
-
         grows = 0
         while True:
             p1 = self.level_phase1(frontier, msum, n_f)
-            if not bool(p1.overflow_x):
+            if not bool(jax.device_get(p1.overflow_x)):
                 break
             if grows >= 8:
                 raise RuntimeError(
@@ -810,7 +811,7 @@ class ShardedChecker:
             abort=p1.abort, abort_at=p1.abort_at, cand_max=p1.cand_max,
             overflow_x=jnp.zeros((), bool), overflow_v=jnp.zeros((), bool),
         )
-        if bool(p1.abort):
+        if bool(jax.device_get(p1.abort)):
             return SimpleNamespace(
                 n_new_total=jnp.asarray(0, I64), children=None,
                 child_msum=None, n_new_local=None, gpidx=None, slots=None,
@@ -824,7 +825,8 @@ class ShardedChecker:
         boosted = False
         while True:
             p2 = self.level_phase2(frontier, p1.cv, p1.cp, vr, n_f)
-            if not (bool(p2.ovf_w) or bool(p2.ovf_c)):
+            ovf_w, ovf_c = jax.device_get((p2.ovf_w, p2.ovf_c))
+            if not (bool(ovf_w) or bool(ovf_c)):
                 break
             if grows >= 8:
                 raise RuntimeError(
@@ -833,7 +835,7 @@ class ShardedChecker:
                 )
             grows += 1
             self.reactive_grows += 1
-            if bool(p2.ovf_c):
+            if bool(ovf_c):
                 # an owner received more new states than its cap_x
                 # frontier block: growing cap_w cannot help — grow cap_x
                 # and redo the WHOLE level (phase-1 shapes change)
@@ -857,7 +859,7 @@ class ShardedChecker:
             self._cap_w_boost = 1
             for k in ("level_phase2", "cap_w"):
                 self.__dict__.pop(k, None)
-        n2 = int(np.asarray(p2.n_new_total))
+        n2 = int(jax.device_get(p2.n_new_total))
         if n2 != n_new:
             raise RuntimeError(
                 f"host-store verdict mismatch: stores admitted {n_new} "
@@ -930,6 +932,7 @@ class ShardedChecker:
         cp_raw, lane, overflow = _compact_payloads(
             valid.ravel(), payload, self.cap_x
         )
+        # graftlint: waive[GL005] — clipped segment-relative row, < seg_rows
         lidx = jnp.clip(
             (cp_raw // K) - dev * capf - base, 0, rows - 1
         ).astype(I32)
@@ -1212,8 +1215,15 @@ class ShardedChecker:
     # -- deep-mode program cache ------------------------------------------
 
     def _dprog(self, key, build):
+        # all deep-mode program fetch/build goes through here, so this
+        # one assert is the always-on choke point keeping device
+        # dispatch off the _io_pool/_ck_pool worker threads
+        graft_sanitize.assert_device_dispatch_ok(
+            f"deep program dispatch ({key!r})"
+        )
         prog = self._dp.get(key)
         if prog is None:
+            graft_sanitize.note_shape_event(f"deep program build {key!r}")
             prog = self._dp[key] = build()
         return prog
 
@@ -1359,16 +1369,24 @@ class ShardedChecker:
         # concurrently dispatched device programs interleave their
         # collectives differently across devices and deadlock the CPU
         # rendezvous (the reason the prefix fetch is one main-thread
-        # dispatch, see _deep_prefix).
+        # dispatch, see _deep_prefix).  The initializer marks each worker
+        # no-dispatch so any future code path that DOES reach a device
+        # program from a worker fails loudly instead of deadlocking
+        # (graftlint GL007's runtime twin; always on, one thread-local
+        # write per worker).
         return ThreadPoolExecutor(
-            max_workers=max(2, min(self.D, os.cpu_count() or 2))
+            max_workers=max(2, min(self.D, os.cpu_count() or 2)),
+            initializer=graft_sanitize.forbid_device_dispatch_in_thread,
         )
 
     @functools.cached_property
     def _ck_pool(self):
         from concurrent.futures import ThreadPoolExecutor
 
-        return ThreadPoolExecutor(max_workers=1)  # deferred tail writes
+        return ThreadPoolExecutor(  # deferred tail writes
+            max_workers=1,
+            initializer=graft_sanitize.forbid_device_dispatch_in_thread,
+        )
 
     def _grow_deep(self, what):
         """Reactive capacity growth for the deep path (recompiles)."""
@@ -1387,7 +1405,9 @@ class ShardedChecker:
         new_scap = min(new_scap, self.scap_max)
         if new_scap <= self.scap:
             return
-        arr = np.asarray(self._sieve_cache).reshape(self.D, self.scap)
+        arr = np.asarray(
+            jax.device_get(self._sieve_cache)
+        ).reshape(self.D, self.scap)
         pad = np.full((self.D, new_scap - self.scap), SENT)
         self.scap = new_scap
         self._sieve_cache = jax.device_put(
@@ -1562,14 +1582,18 @@ class ShardedChecker:
             self._grow_deep(
                 "cap_c" if any(bool(c) for _w, c in flags) else "cap_w"
             )
-        n2 = sum(int(np.asarray(p.n_new_total)) for p in p2s)
+        n2s, invs, nls = jax.device_get(
+            ([p.n_new_total for p in p2s], [p.inv_bad for p in p2s],
+             [p.n_new_local for p in p2s])
+        )
+        n2 = sum(int(x) for x in n2s)
         n_new = int(inserted.sum())
         if n2 != n_new:
             raise RuntimeError(
                 f"deep verdict mismatch: stores admitted {n_new} new "
                 f"states, phase 2 materialized {n2}"
             )
-        inv_total = sum(int(np.asarray(p.inv_bad)) for p in p2s)
+        inv_total = sum(int(x) for x in invs)
         inv = None
         if inv_total > 0:
             for p in p2s:
@@ -1579,16 +1603,16 @@ class ShardedChecker:
                     cap_c = self.cap_c_deep
                     gidx = int(devs[0]) * cap_c + int(ba[devs[0]])
                     inv = (
-                        np.asarray(p.gpidx).astype(np.int64),
-                        np.asarray(p.slots).astype(np.int64),
+                        np.asarray(jax.device_get(p.gpidx), np.int64),
+                        np.asarray(jax.device_get(p.slots), np.int64),
                         gidx,
                     )
                     break
 
         # --- repack shipped children into uniform 1/D segments ----------
         nl = np.zeros(D, np.int64)
-        for p in p2s:
-            nl += np.asarray(p.n_new_local).astype(np.int64).reshape(D)
+        for x in nls:
+            nl += np.asarray(x, np.int64).reshape(D)
         n_out = max(1, -(-int(nl.max()) // seg))
         cap_c = self.cap_c_deep
         pads_k, pads_n = [], []
@@ -1616,8 +1640,9 @@ class ShardedChecker:
         segs_new, gpo, slo, _nloc = self._deep_rp(Rq, n_out)(
             ch_stack, gp_stack, sl_stack
         )
-        gpidx_np = np.asarray(gpo).astype(np.int64)
-        slots_np = np.asarray(slo).astype(np.int64)
+        gpo_np, slo_np = jax.device_get((gpo, slo))
+        gpidx_np = np.asarray(gpo_np, np.int64)
+        slots_np = np.asarray(slo_np, np.int64)
 
         # --- sieve cache update (level end: the level's own candidates
         # must never sieve each other — exact representative choice) ----
@@ -1626,7 +1651,7 @@ class ShardedChecker:
             ovf_s = False
             for p in p1s:
                 self._sieve_cache, ovf = sv(self._sieve_cache, p.cv)
-                ovf_s = ovf_s or bool(np.asarray(ovf))
+                ovf_s = ovf_s or bool(jax.device_get(ovf))
             if ovf_s and self.scap < self.scap_max:
                 print(
                     f"[mesh-deep] sieve cache full at level {depth + 1}: "
@@ -1655,8 +1680,6 @@ class ShardedChecker:
         presize: bool = True,
     ) -> CheckResult:
         """The sharded deep-sweep driver (frontier 1/D across devices)."""
-        from types import SimpleNamespace
-
         cfg, D, seg = self.cfg, self.D, self.seg_rows
         shard = NamedSharding(self.mesh, P("d"))
         repl = NamedSharding(self.mesh, P())
@@ -1705,7 +1728,9 @@ class ShardedChecker:
             R = max(1, -(-rows // seg))
             fr_np = {}
             for f in RaftState._fields:
-                v = np.asarray(getattr(fr, f))
+                # intended one-time resume sync (ledgered explicit get:
+                # the rebuilt frontier re-splits into uniform segments)
+                v = np.asarray(jax.device_get(getattr(fr, f)))
                 fr_np[f] = v.reshape((D, rows) + v.shape[1:])
             segments = []
             for r in range(R):
@@ -1725,7 +1750,9 @@ class ShardedChecker:
                         shard,
                     )
                 segments.append(RaftState(**segd))
-            n_f_np = np.asarray(ck["n_f"], np.int64).reshape(D)
+            n_f_np = np.asarray(
+                jax.device_get(ck["n_f"]), np.int64
+            ).reshape(D)
             distinct, generated, depth = (
                 ck["distinct"], ck["generated"], ck["depth"],
             )
@@ -1738,7 +1765,7 @@ class ShardedChecker:
             fv0, _ff0, _ms0 = self.fpr.state_fingerprints(
                 init_batch(cfg, 1)
             )
-            fp0 = np.asarray(fv0.astype(U64))[0]
+            fp0 = np.asarray(jax.device_get(fv0.astype(U64)))[0]
             self.host_stores[int(fp0 % D)].insert(
                 np.asarray([fp0], np.uint64)
             )
@@ -1750,7 +1777,7 @@ class ShardedChecker:
 
             chk0 = JaxChecker(cfg)
             init1 = jax.device_put(init_batch(cfg, 1), repl)
-            bad0 = int(np.asarray(
+            bad0 = int(jax.device_get(
                 chk0._inv_scan(init1, jnp.asarray(1, I64))
             ))
             if bad0 >= 0:
@@ -1850,6 +1877,15 @@ class ShardedChecker:
                         exchange_reduction=st["reduction"],
                     )
                 )
+            if graft_sanitize.CURRENT is not None:
+                sig = (
+                    len(segments), self.seg_rows, self.cap_x,
+                    self.scap, self.cap_c_deep, self.cap_w,
+                )
+                if sig != getattr(self, "_san_sig", None):
+                    graft_sanitize.note_shape_event(f"deep level {sig}")
+                    self._san_sig = sig
+                graft_sanitize.level_tick()
             if out["inv"] is not None:
                 gp_r, sl_r, gidx = out["inv"]
                 trace = self._trace(
@@ -2022,7 +2058,7 @@ class ShardedChecker:
         fv0, _ff0, _ms0 = self.fpr.state_fingerprints(
             jax.tree.map(lambda x: x[:1], frontier)
         )
-        fps_all = [np.asarray(fv0.astype(U64))]
+        fps_all = [np.asarray(jax.device_get(fv0.astype(U64)))]
         trace_levels, level_sizes = [], [1]
         mult_slots_total = np.zeros(K, np.int64)
         depth = 0
@@ -2095,7 +2131,7 @@ class ShardedChecker:
                 children,
             )
             fv, _ff, _ms = self.fpr.state_fingerprints(children)
-            fps_all.append(np.asarray(fv.astype(U64))[valid])
+            fps_all.append(np.asarray(jax.device_get(fv.astype(U64)))[valid])
             trace_levels.append((gpidx, slots))
             level_sizes.append(n_new)
             mult_slots_total = mult_slots_total + z["mult"].astype(np.int64)
@@ -2120,7 +2156,7 @@ class ShardedChecker:
         # are untouched).
         if trace_levels and D > 1:
             cap_cr = frontier.voted_for.shape[0] // D
-            fvh = np.asarray(fv.astype(U64))
+            fvh = np.asarray(jax.device_get(fv.astype(U64)))
             validh = np.asarray(valid)
             own = np.where(
                 validh, (fvh % np.uint64(D)).astype(np.int64), D
@@ -2351,7 +2387,7 @@ class ShardedChecker:
                 msum0 = jnp.zeros((D, 1, 1), jnp.uint32)
             msum = jax.device_put(msum0, shard)
             n_f = jax.device_put(jnp.asarray([1] + [0] * (D - 1), I64), shard)
-            fp0 = np.asarray(fv.astype(U64))[0]
+            fp0 = np.asarray(jax.device_get(fv.astype(U64)))[0]
             if self.host_stores is not None:
                 self.host_stores[int(fp0 % D)].insert(
                     np.asarray([fp0], np.uint64)
@@ -2376,7 +2412,9 @@ class ShardedChecker:
 
             chk0 = JaxChecker(cfg)
             init1 = jax.device_put(init_batch(cfg, 1), repl)
-            bad0 = int(np.asarray(chk0._inv_scan(init1, jnp.asarray(1, I64))))
+            bad0 = int(
+                jax.device_get(chk0._inv_scan(init1, jnp.asarray(1, I64)))
+            )
             if bad0 >= 0:
                 name0 = chk0._bad_invariant_name(init1, bad0)
                 return CheckResult(
@@ -2470,7 +2508,10 @@ class ShardedChecker:
                 grows = 0
                 while True:
                     out = self.level_step(frontier, msum, n_f, visited)
-                    if not (bool(out.overflow_v) or bool(out.overflow_x)):
+                    ovf_v, ovf_x = jax.device_get(
+                        (out.overflow_v, out.overflow_x)
+                    )
+                    if not (bool(ovf_v) or bool(ovf_x)):
                         break
                     if grows >= 8:
                         raise RuntimeError(
@@ -2482,11 +2523,11 @@ class ShardedChecker:
                     self.reactive_grows += 1
                     print(
                         f"[mesh] REACTIVE grow at level {depth + 1}: "
-                        f"{'vcap' if bool(out.overflow_v) else 'cap_x'} "
+                        f"{'vcap' if bool(ovf_v) else 'cap_x'} "
                         f"(cap_x={self.cap_x}, cap_r={self.cap_r}, "
                         f"vcap={self.vcap})", file=sys.stderr,
                     )
-                    if bool(out.overflow_v):
+                    if bool(ovf_v):
                         visited = grow_visited(visited, self.vcap * 4)
                     else:
                         # candidate compaction / routing lanes overflowed:
@@ -2494,10 +2535,18 @@ class ShardedChecker:
                         self.cap_x *= 2
                         for k in ("level_step", "cap_r", "cap_w"):
                             self.__dict__.pop(k, None)
-            if bool(out.abort):
+            # one fused fetch of the level's control scalars (the ledger
+            # of intended per-level syncs the sanitizer audits against)
+            (abort_np, mult_np, gen_np, nnew_np, inv_np, cand_np,
+             nloc_np) = jax.device_get((
+                out.abort, out.mult_slots, out.generated,
+                out.n_new_total, out.inv_bad, out.cand_max,
+                out.n_new_local,
+            ))
+            if bool(abort_np):
                 # locate the aborting parent (a current-frontier state) and
                 # replay its slot chain, exactly like the single-device path
-                bad_at = np.asarray(out.abort_at)
+                bad_at = np.asarray(jax.device_get(out.abort_at))
                 devs = np.nonzero(bad_at >= 0)[0]
                 cap_f = frontier.voted_for.shape[0] // D
                 gidx = int(devs[0]) * cap_f + int(bad_at[devs[0]])
@@ -2509,19 +2558,19 @@ class ShardedChecker:
                         self._trace(trace_levels, depth, gidx),
                     ),
                 )
-            mult_slots_total += np.asarray(out.mult_slots)
-            generated += int(np.asarray(out.generated))
-            n_new = int(out.n_new_total)
+            mult_slots_total += np.asarray(mult_np)
+            generated += int(gen_np)
+            n_new = int(nnew_np)
             if n_new == 0:
                 break
             cap_f_prev = frontier.voted_for.shape[0] // D
             distinct += n_new
             level_sizes.append(n_new)
-            self._cand_hist.append(int(np.asarray(out.cand_max)) / n_new)
+            self._cand_hist.append(int(cand_np) / n_new)
             depth += 1
+            gp_np, sl_np = jax.device_get((out.gpidx, out.slots))
             trace_levels.append(
-                (np.asarray(out.gpidx).astype(np.int64),
-                 np.asarray(out.slots).astype(np.int64))
+                (np.asarray(gp_np, np.int64), np.asarray(sl_np, np.int64))
             )
             if self.host_stores is None:
                 visited = out.visited
@@ -2553,8 +2602,18 @@ class ShardedChecker:
                         generated=generated, elapsed=time.monotonic() - t0,
                     )
                 )
-            if int(np.asarray(out.inv_bad)) > 0:
-                bad_at = np.asarray(out.inv_bad_at)
+            if graft_sanitize.CURRENT is not None:
+                sig = (
+                    frontier.voted_for.shape[0],
+                    0 if visited is None else visited.shape[0],
+                    self.cap_x, self.cap_w, self.vcap,
+                )
+                if sig != getattr(self, "_san_sig", None):
+                    graft_sanitize.note_shape_event(f"mesh level {sig}")
+                    self._san_sig = sig
+                graft_sanitize.level_tick()
+            if int(inv_np) > 0:
+                bad_at = np.asarray(jax.device_get(out.inv_bad_at))
                 devs = np.nonzero(bad_at >= 0)[0]
                 gidx = int(devs[0]) * (out.children.voted_for.shape[0] // D) + int(
                     bad_at[devs[0]]
@@ -2581,7 +2640,17 @@ class ShardedChecker:
             # replay chain needs EVERY level, so checkpoint_every only
             # gates whether checkpointing happens at all.
             if checkpoint_dir and checkpoint_every:
-                self._save_mdelta(checkpoint_dir, depth, out, cap_f_prev)
+                # pass the HOST copies fetched above — _save_mdelta on
+                # the raw LevelOut would re-fetch gpidx/slots (the two
+                # largest per-level arrays) a second time per level
+                self._save_mdelta(
+                    checkpoint_dir, depth,
+                    SimpleNamespace(
+                        gpidx=gp_np, slots=sl_np,
+                        n_new_local=nloc_np, mult_slots=mult_np,
+                    ),
+                    cap_f_prev,
+                )
 
         return CheckResult(
             True, distinct, generated, depth, tuple(level_sizes), None,

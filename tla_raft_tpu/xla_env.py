@@ -37,7 +37,7 @@ def _collective_flags_supported() -> bool:
         from importlib.metadata import version
 
         tag = version("jaxlib")
-    except Exception:
+    except ImportError:  # PackageNotFoundError subclasses ImportError
         tag = "unknown"
     cache_dir = os.path.expanduser("~/.cache/tla_raft_tpu")
     cache = os.path.join(cache_dir, f"xla_coll_flags_{tag}")
@@ -59,7 +59,7 @@ def _collective_flags_supported() -> bool:
             ).returncode
             == 0
         )
-    except Exception:
+    except (subprocess.SubprocessError, OSError):
         # a timeout/OSError is TRANSIENT (loaded host), not a verdict on
         # the jaxlib — run without the guards this process, but do not
         # poison the per-version cache (a clean non-zero exit IS the
