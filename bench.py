@@ -332,6 +332,10 @@ def main():
         )
         sys.stderr.flush()
 
+    # BENCH_HASHSTORE=0 pins the sort-based visited path — the A/B lever
+    # for the hashstore-vs-lexsort dedup comparison (BENCH_HASHSTORE vs
+    # BENCH_r06 at equal config); default follows the engine default (on)
+    use_hs = bool(int(os.environ.get("BENCH_HASHSTORE", "1")))
     exchange = None
     peak_dev_rows = None
     try:
@@ -351,16 +355,16 @@ def main():
                 cap_x=int(os.environ.get("BENCH_CAP_X", "4096")),
                 host_store_dir=fpdir, deep=deep,
                 seg_rows=int(os.environ.get("BENCH_SEG_ROWS", str(1 << 15))),
-                progress=progress,
+                progress=progress, use_hashstore=use_hs,
             )
             res = mchk.run(max_depth=max_depth)
             if mchk.meter.levels:
                 exchange = mchk.meter.summary()
             peak_dev_rows = getattr(mchk, "peak_dev_rows", None)
         else:
-            res = JaxChecker(cfg, chunk=chunk, progress=progress).run(
-                max_depth=max_depth
-            )
+            res = JaxChecker(
+                cfg, chunk=chunk, progress=progress, use_hashstore=use_hs,
+            ).run(max_depth=max_depth)
     except Exception as e:
         _emit_failure("engine_run", e)
         return 1
@@ -452,6 +456,7 @@ def main():
         },
         "device": str(jax.devices()[0]),
         "config": cfg.describe(),
+        "hashstore": use_hs,
     }
     if full_golden is not None:
         out["golden_full"] = {
@@ -496,6 +501,7 @@ def main():
             "depth": out["depth"],
             "vs_baseline": out["vs_baseline"],
             "device": out["device"],
+            "hashstore": out["hashstore"],
         }
         for k in ("mesh", "mesh_deep", "peak_dev_rows", "exchange"):
             if k in out:

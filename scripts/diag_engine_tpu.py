@@ -46,7 +46,7 @@ PATH = "/tmp/diag_engine_levels.npz"
 cfg = load_raft_config("/root/reference/Raft.cfg")
 print("backend:", jax.default_backend(), "chunk:", chunk)
 
-chk = JaxChecker(cfg, chunk=chunk)
+chk = JaxChecker(cfg, chunk=chunk, use_hashstore=False)
 records = []
 
 orig = JaxChecker._expand_level
@@ -54,7 +54,8 @@ orig = JaxChecker._expand_level
 
 def recording(self, frontier, n_f, visited):
     out = orig(self, frontier, n_f, visited)
-    n_new, new_fps, new_payload, abort_at, overflow, overflow_g, mult = out
+    (n_new, new_fps, new_payload, abort_at, overflow, overflow_g, _ovf_h,
+     mult) = out
     records.append(
         dict(
             frontier={k: np.asarray(v) for k, v in frontier._asdict().items()},
@@ -102,9 +103,8 @@ for li in range(n_levels):
     want_fps = z[f"l{li}_newfps"]
     want_pay = z[f"l{li}_newpay"]
     want_mult = z[f"l{li}_mult"]
-    n_new, new_fps, new_payload, abort_at, overflow, overflow_g, mult = chk._expand_level(
-        frontier, n_f, visited
-    )
+    (n_new, new_fps, new_payload, abort_at, overflow, overflow_g, _ovf_h,
+     mult) = chk._expand_level(frontier, n_f, visited)
     new_fps = np.asarray(new_fps)
     new_payload = np.asarray(new_payload)
     lim = min(n_new, want_n)

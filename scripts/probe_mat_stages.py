@@ -31,7 +31,7 @@ from tla_raft_tpu.engine.bfs import I64, SENT, U64, _group_filter
 cfg = load_raft_config("/root/reference/Raft.cfg")
 print("backend:", jax.default_backend(), "chunk:", chunk, "depth:", depth)
 
-chk = JaxChecker(cfg, chunk=chunk)
+chk = JaxChecker(cfg, chunk=chunk, use_hashstore=False)
 state = {}
 orig = JaxChecker._expand_level
 

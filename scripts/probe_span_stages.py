@@ -44,7 +44,8 @@ from tla_raft_tpu.engine.bfs import (
 cfg = load_raft_config("/root/reference/Raft.cfg")
 print("backend:", jax.default_backend(), "chunk:", chunk, "depth:", depth)
 
-chk = JaxChecker(cfg, chunk=chunk)
+chk = JaxChecker(cfg, chunk=chunk, use_hashstore=False)  # probes the
+# sort-path stages (_group_filter/_level_dedup) at real lane counts
 state = {}
 orig = JaxChecker._expand_level
 

@@ -37,7 +37,10 @@ from tla_raft_tpu.engine.bfs import I64, _pow2
 
 cfg = load_raft_config("/root/reference/Raft.cfg")
 canon = os.environ.get("PROFILE_CANON", "late")
-chk = JaxChecker(cfg, chunk=chunk, canon=canon)
+# this script profiles the SORT-path stages (group_filter/level_dedup/
+# merge_sorted) explicitly — pin the sort path so the hashstore default
+# doesn't silently bypass the wrapped functions
+chk = JaxChecker(cfg, chunk=chunk, canon=canon, use_hashstore=False)
 print("backend:", jax.default_backend(), "chunk:", chunk, "canon:", canon)
 
 ck = (
@@ -97,7 +100,8 @@ bfs._level_dedup = wrap("level_dedup", orig_dedup)
 bfs._merge_sorted = wrap("merge_sorted", orig_merge)
 
 t0 = time.monotonic()
-(n_new, new_fps, new_payload, abort_at, overflow, overflow_g, mult) = (
+(n_new, new_fps, new_payload, abort_at, overflow, overflow_g, _ovf_h,
+ mult) = (
     chk._expand_level(frontier, int(n_f), visited)
 )
 t_expand_level = time.monotonic() - t0

@@ -38,7 +38,7 @@ from tla_raft_tpu.engine.bfs import I64, _level_dedup, _merge_sorted
 cfg = load_raft_config("/root/reference/Raft.cfg")
 print("backend:", jax.default_backend(), "chunk:", chunk, "to depth", depth)
 
-chk = JaxChecker(cfg, chunk=chunk)
+chk = JaxChecker(cfg, chunk=chunk, use_hashstore=False)
 state = {}
 
 t0 = time.monotonic()
@@ -48,7 +48,7 @@ print(
     f"{res.distinct} distinct, {time.monotonic() - t0:.1f}s"
 )
 
-chk2 = JaxChecker(cfg, chunk=chunk)
+chk2 = JaxChecker(cfg, chunk=chunk, use_hashstore=False)
 
 
 # re-run capturing the last level's inputs

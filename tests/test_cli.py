@@ -13,6 +13,8 @@ import json
 
 import pytest
 
+from refenv import requires_reference
+
 from tla_raft_tpu.check import main
 
 TINY = ["--servers", "2", "--vals", "1", "--max-election", "1",
@@ -32,6 +34,7 @@ def run_cli(tmp_path, *args):
     return rc, buf.getvalue(), log
 
 
+@requires_reference
 def test_clean_sweep_exit_zero_and_log_tee(tmp_path):
     rc, out, log = run_cli(tmp_path, *TINY, "--backend", "oracle")
     assert rc == 0
@@ -42,12 +45,14 @@ def test_clean_sweep_exit_zero_and_log_tee(tmp_path):
     assert log.read_text() == out
 
 
+@requires_reference
 def test_jax_backend_matches_oracle_counts(tmp_path):
     rc, out, _ = run_cli(tmp_path, *TINY, "--chunk", "64")
     assert rc == 0
     assert "97 states generated, 50 distinct states found, depth 12." in out
 
 
+@requires_reference
 def test_violation_exit_one_with_trace(tmp_path):
     # ~RaftCanCommt is a reachability probe: checking its negation MUST
     # find a violation with a replayable trace (SURVEY.md §4.3)
@@ -61,6 +66,7 @@ def test_violation_exit_one_with_trace(tmp_path):
     assert "STATE 1" in out  # TLC-shaped numbered trace from Init
 
 
+@requires_reference
 def test_json_summary_line(tmp_path):
     rc, out, _ = run_cli(tmp_path, *TINY, "--backend", "oracle", "--json")
     assert rc == 0
@@ -77,6 +83,7 @@ def test_usage_error_exit_two(tmp_path):
     assert ei.value.code == 2
 
 
+@requires_reference
 def test_mutation_is_caught_with_counterexample(tmp_path):
     # the planted FindMedian ÷2 bug (Raft.tla:65-66) must produce a
     # genuine Inv violation when compiled in (SURVEY.md §4.4)

@@ -10,6 +10,8 @@ import subprocess
 
 import pytest
 
+from refenv import requires_reference
+
 from tla_raft_tpu.native import build_cpubase
 
 
@@ -27,6 +29,7 @@ def run_native(binary, S, V, maxE, maxR, depth, threads=2):
     return json.loads(out.stdout)
 
 
+@requires_reference
 def test_reference_config_matches_oracle(binary):
     from tla_raft_tpu.cfgparse import load_raft_config
     from tla_raft_tpu.oracle import OracleChecker

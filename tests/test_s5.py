@@ -16,6 +16,8 @@ import dataclasses
 import numpy as np
 import pytest
 
+from refenv import skip_unless_reference
+
 from tla_raft_tpu.cfgparse import load_raft_config
 from tla_raft_tpu.engine import JaxChecker
 from tla_raft_tpu.models.raft import from_oracle
@@ -26,6 +28,7 @@ from tla_raft_tpu.oracle.explicit import init_state, successors
 
 @pytest.fixture(scope="module")
 def cfg5():
+    skip_unless_reference()
     cfg = load_raft_config("/root/reference/Raft.cfg")
     return dataclasses.replace(cfg, n_servers=5)
 

@@ -19,6 +19,8 @@ import dataclasses
 import numpy as np
 import pytest
 
+from refenv import skip_unless_reference
+
 from tla_raft_tpu.cfgparse import load_raft_config
 from tla_raft_tpu.config import RaftConfig
 from tla_raft_tpu.engine import JaxChecker
@@ -31,6 +33,7 @@ from tla_raft_tpu.oracle.explicit import init_state, successors
 
 @pytest.fixture(scope="module")
 def cfg7():
+    skip_unless_reference()
     # bounded 7-server space: the oracle pays 5040 permutations per
     # canonical key in pure Python, so keep the test space tiny
     cfg = load_raft_config("/root/reference/Raft.cfg")

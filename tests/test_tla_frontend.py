@@ -10,6 +10,7 @@ rejected by the front-end rather than silently checked with the compiled
 
 import pytest
 
+from refenv import requires_reference
 from tla_raft_tpu.tla_frontend import (
     EXPECTED_ACTIONS,
     extract_skeleton,
@@ -17,6 +18,9 @@ from tla_raft_tpu.tla_frontend import (
 )
 
 REF = "/root/reference/Raft.tla"
+
+# every test here reads the reference spec file itself
+pytestmark = requires_reference
 
 
 def test_reference_spec_validates():

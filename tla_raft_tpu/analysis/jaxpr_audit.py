@@ -70,6 +70,7 @@ def kernel_registry():
     import jax.numpy as jnp
 
     from ..models.raft import init_batch
+    from ..ops import hashstore
     from ..ops.successor import get_kernel
     from ..parallel.exchange import pack_fp_deltas
 
@@ -81,6 +82,8 @@ def kernel_registry():
     slots = jnp.zeros((8,), jnp.int64)
     fps = jnp.zeros((256,), jnp.uint64)
     n = jnp.asarray(0, jnp.int64)
+    slab = jnp.zeros((hashstore.MIN_CAP,), jnp.uint64)
+    pays = jnp.zeros((256,), jnp.int64)
 
     return {
         "successor.expand_guards":
@@ -93,6 +96,17 @@ def kernel_registry():
             lambda: jax.make_jaxpr(fpr.state_fingerprints)(st),
         "exchange.pack_fp_deltas":
             lambda: jax.make_jaxpr(pack_fp_deltas)(fps, n),
+        # the open-addressing visited store (ops/hashstore.py): the
+        # probe hot path must stay at its pinned ONE gather per probe
+        # round (plus the claim scatter-min / compaction scatters of
+        # probe_and_insert) — any drift back toward the searchsorted
+        # gather storm or a data-indexed sort fails the ledger diff
+        "hashstore.probe":
+            lambda: jax.make_jaxpr(hashstore.probe_impl)(slab, fps),
+        "hashstore.probe_and_insert":
+            lambda: jax.make_jaxpr(hashstore.probe_and_insert_impl)(
+                slab, fps, fps, pays
+            ),
     }
 
 

@@ -162,6 +162,10 @@ def main(argv=None) -> int:
     p.add_argument("--no-compress", action="store_true",
                    help="deep mode: fetch raw u64 fingerprints instead of "
                         "the delta-packed stream")
+    p.add_argument("--no-hashstore", action="store_true",
+                   help="revert to the sort-based visited path (lexsort "
+                        "+ searchsorted + sorted merge) instead of the "
+                        "on-device open-addressing fingerprint store")
     p.add_argument("--cap-x", type=int, default=4096,
                    help="per-device candidate capacity (distributed mode)")
     p.add_argument("--canon", choices=("late", "expand"), default="late",
@@ -296,6 +300,7 @@ def main(argv=None) -> int:
                 host_store_dir=args.fpstore_dir or None,
                 deep=args.mesh_deep, seg_rows=args.seg_rows,
                 sieve=not args.no_sieve, compress=not args.no_compress,
+                use_hashstore=not args.no_hashstore,
             )
             with sanctx:
                 res = chk.run(
@@ -329,6 +334,7 @@ def main(argv=None) -> int:
                 res = JaxChecker(
                     cfg, chunk=args.chunk, progress=progress,
                     host_store=host_store, canon=args.canon,
+                    use_hashstore=not args.no_hashstore,
                 ).run(
                     max_depth=args.max_depth,
                     checkpoint_dir=args.checkpoint_dir,

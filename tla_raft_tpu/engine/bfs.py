@@ -68,6 +68,7 @@ import numpy as np
 from ..analysis import sanitize as graft_sanitize
 from ..config import RaftConfig
 from ..models.raft import RaftState, init_batch, to_oracle
+from ..ops import hashstore
 from ..ops.successor import SuccessorKernel, get_kernel
 from .forecast import MIN_LEVELS as PRESIZE_MIN_LEVELS, pow2ceil as _pow2
 from .invariants import resolve_invariant_kernel
@@ -289,20 +290,12 @@ def _chunk_compact(fps_view, fps_full, payload, cap_x: int):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cap_g",))
-def _group_filter(cv, cf, cp, visited, cap_g: int):
-    """Drop already-visited candidates from a group of chunks and compact.
-
-    At deep levels ~85-90% of candidate lanes are revisits of the sorted
-    store; filtering a fixed-size group before the level-wide sort keeps
-    that sort (and its working set) proportional to the NEW states, not
-    the whole fan-out.  Dropping a visited view fingerprint removes its
-    whole candidate group, so downstream representative choice is
-    unaffected; compaction preserves lane order (stable top_k key).
-    """
+def _filter_compact(hit, cv, cf, cp, cap_g: int):
+    """Shared tail of the two group filters: drop hit lanes, compact
+    the survivors into cap_g lanes preserving lane order (stable top_k
+    key).  ONE implementation so the hash and sorted membership tests
+    can never drift on the compaction contract."""
     C = cv.shape[0]
-    pos = jnp.searchsorted(visited, cv)
-    hit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == cv
     keep = (cv != SENT) & ~hit
     n = keep.sum()
     key = jnp.where(keep, C - jnp.arange(C, dtype=I32), 0)
@@ -314,6 +307,55 @@ def _group_filter(cv, cf, cp, visited, cap_g: int):
         jnp.where(lane, cp[idx], -1),
         n > cap_g,
     )
+
+
+@functools.partial(jax.jit, static_argnames=("cap_g",))
+def _group_filter(cv, cf, cp, visited, cap_g: int):
+    """Drop already-visited candidates from a group of chunks and compact.
+
+    At deep levels ~85-90% of candidate lanes are revisits of the sorted
+    store; filtering a fixed-size group before the level-wide sort keeps
+    that sort (and its working set) proportional to the NEW states, not
+    the whole fan-out.  Dropping a visited view fingerprint removes its
+    whole candidate group, so downstream representative choice is
+    unaffected; compaction preserves lane order (stable top_k key).
+    """
+    pos = jnp.searchsorted(visited, cv)
+    hit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == cv
+    return _filter_compact(hit, cv, cf, cp, cap_g)
+
+
+@functools.partial(jax.jit, static_argnames=("cap_g",))
+def _group_filter_hash(cv, cf, cp, slab, cap_g: int):
+    """``_group_filter`` with the open-addressing store: the visited
+    membership test is a depth-bounded hash probe (O(1) expected, 2-3
+    gather rounds at the enforced <=1/2 load) instead of a binary
+    search's ~22 rounds of random gathers against the sorted table —
+    the membership-side gather cliff (docs/PERF.md "Hashed visited
+    store").  Compaction is the SHARED ``_filter_compact`` tail."""
+    hit = hashstore.probe_impl(slab, cv)
+    return _filter_compact(hit, cv, cf, cp, cap_g)
+
+
+@jax.jit
+def _level_dedup_hash(cv, cf, cp, slab):
+    """Hash-store replacement for ``_level_dedup`` + ``_merge_sorted``:
+    ONE fused probe-and-insert resolves uniqueness, visited membership
+    AND the store update — no 3-key lexsort over the level's lanes, no
+    searchsorted, no whole-store re-sort.  The min-(fp_full, payload)
+    representative per view fingerprint is chosen by the kernel's
+    two-phase min-reduce (the group-min lemma), so counts are
+    bit-identical to the sort path; survivors compact in LANE order
+    (payload-ascending — the same order the external-store path pins).
+
+    Returns (n_new, new_fps, new_payload, slab', overflow).  On
+    overflow the caller grows the store and redoes the level against
+    the ORIGINAL slab (the kernel is functional)."""
+    slab2, fresh, n_new, ovf = hashstore.probe_and_insert_impl(
+        slab, cv, cf, cp
+    )
+    new_fps, new_pay = hashstore.compact_fresh(fresh, cv, cp, cv.shape[0])
+    return n_new, new_fps, new_pay, slab2, ovf
 
 
 @jax.jit
@@ -409,6 +451,7 @@ class JaxChecker:
         host_store=None,
         cap_m: int = 96,
         canon: str = "late",
+        use_hashstore: bool | None = None,
     ):
         # canon="late": expand computes guards only; the compacted
         # candidates are materialized and fingerprinted with the full-state
@@ -467,6 +510,17 @@ class JaxChecker:
         # when set, the device keeps no visited table at all — the level's
         # deduped candidates are filtered through the host store instead
         self.host_store = host_store
+        # device-resident open-addressing visited store (ops/hashstore.py):
+        # replaces the level's 3-key lexsort + searchsorted + sorted store
+        # merge with one fused O(1) probe-and-insert on the device-store
+        # path.  Default ON; TLA_RAFT_HASHSTORE=0 (or --no-hashstore /
+        # use_hashstore=False) reverts to the sort-based path.  Moot when
+        # an external host store is attached (membership lives host-side).
+        if use_hashstore is None:
+            use_hashstore = hashstore.enabled_by_env()
+        self.use_hashstore = bool(use_hashstore) and host_store is None
+        self.hstore = None  # DeviceHashStore, built in run()/resume
+        self._hs_pending = None  # a level's updated slab awaiting adoption
         # device-byte budget for frontier segments (0 = paging off): when
         # one level's parent+child segments would exceed it, sealed child
         # segments demote to host RAM and page back in on demand — the
@@ -1013,6 +1067,13 @@ class JaxChecker:
             self._presize_vcap,
             min(_cap4(distinct + sum(fut) + 1), _cap4(budget // 8)),
         )
+        # hash-slab sizing wants the ENTRY forecast, not a pow4 array
+        # width (the slab layer applies its own load-factor/pow2 quantum;
+        # 8 B/slot at <=1/2 load => entries <= budget/16)
+        self._presize_entries = max(
+            getattr(self, "_presize_entries", 0),
+            min(distinct + sum(fut), budget // 16),
+        )
         self._presize_merge = max(
             self._presize_merge,
             min(_pow2(int(peak * 1.05) + 1), _pow2(budget // 16)),
@@ -1355,7 +1416,10 @@ class JaxChecker:
         cfg, K = self.cfg, self.K
         if os.path.exists(base_path):
             ck = self._load_checkpoint(
-                base_path, device_visited=self.host_store is None
+                base_path,
+                device_visited=(
+                    self.host_store is None and not self.use_hashstore
+                ),
             )
             self._check_fp_def(ck["fp_def"], base_path)
             frontier, n_f = ck["frontier"], ck["n_f"]
@@ -1455,6 +1519,31 @@ class JaxChecker:
         distinct = int(sum(level_sizes))
         if self.host_store is not None:
             visited = jnp.full((64,), SENT, U64)
+        elif self.use_hashstore:
+            # slab checkpoint fast path: the dumped slab IS the visited
+            # set at the resume depth — one device_put instead of a
+            # host-side rebuild.  Any mismatch (depth, count, fp def,
+            # version) falls back to rebuilding from the replayed fps.
+            self.hstore = hashstore.DeviceHashStore.load(
+                os.path.join(ckdir, "hslab.npz"), depth, distinct,
+                int(self.orbit),
+            )
+            if self.hstore is None:
+                parts = [np.asarray(p, np.uint64) for p in fps_parts]
+                if visited_base is not None:
+                    parts.insert(0, np.asarray(visited_base, np.uint64))
+                allf = (
+                    np.concatenate(parts) if parts
+                    else np.empty(0, np.uint64)
+                )
+                self.hstore = hashstore.DeviceHashStore.from_fps(allf)
+            if self.hstore.count != distinct:
+                raise ValueError(
+                    f"hash-store resume rebuilt {self.hstore.count} "
+                    f"distinct fingerprints for {distinct} recorded "
+                    "states — corrupt or mixed log"
+                )
+            visited = jnp.full((64,), SENT, U64)
         else:
             new_fp_count = int(sum(len(p) for p in fps_parts))
             parts_dev = (
@@ -1483,6 +1572,15 @@ class JaxChecker:
     def _save_checkpoint(self, path, frontier, visited, n_f, distinct,
                          generated, depth, level_sizes, trace_levels,
                          mult_per_slot):
+        if self.use_hashstore and self.hstore is not None:
+            # the run's visited set lives in the hash slab; the monolith
+            # format pins a SORTED array (it seeds host stores and
+            # sorted-mode resumes), so derive it from the live slots
+            # graftlint: waive[GL006] — one slab fetch per monolith save
+            vb = np.asarray(jax.device_get(self.hstore.slab))
+            vb = np.sort(vb[vb != SENT])
+            pad = _cap4(len(vb) + 1) - len(vb)
+            visited = np.concatenate([vb, np.full(pad, SENT)])
         arrs = {f"st_{k}": np.asarray(v) for k, v in frontier._asdict().items()}
         for i, (p, s) in enumerate(trace_levels):
             arrs[f"trace_p{i}"] = p
@@ -1587,6 +1685,15 @@ class JaxChecker:
         multiple of the chunk size).
         """
         n_f_dev = jnp.asarray(n_f, I64)
+        use_hs = self.use_hashstore
+        hslab = self.hstore.slab if use_hs else None
+
+        def gfilter(av, af, ap):
+            """Visited filter for one group: hash probe or searchsorted."""
+            if use_hs:
+                return _group_filter_hash(av, af, ap, hslab, self.cap_g)
+            return _group_filter(av, af, ap, visited, self.cap_g)
+
         cvs, cfs, cps = [], [], []  # pending (unfiltered) chunk outputs
         gvs, gfs, gps = [], [], []  # filtered+compacted group outputs
         svs, sfs, sps = [], [], []  # ungrouped span outputs ([G*cap_x] each)
@@ -1617,9 +1724,9 @@ class JaxChecker:
                 cvs.append(jnp.full((self.cap_x,), SENT, U64))
                 cfs.append(jnp.full((self.cap_x,), SENT, U64))
                 cps.append(jnp.full((self.cap_x,), -1, I64))
-            gv, gf, gp, ovf = _group_filter(
+            gv, gf, gp, ovf = gfilter(
                 jnp.concatenate(cvs), jnp.concatenate(cfs),
-                jnp.concatenate(cps), visited, self.cap_g,
+                jnp.concatenate(cps),
             )
             gvs.append(gv)
             gfs.append(gf)
@@ -1648,9 +1755,9 @@ class JaxChecker:
                 abort_at = jnp.minimum(abort_at, ab_s)
                 overflow = overflow | ovf_s
                 if grouping:
-                    gv, gf, gp, ovf_g = _group_filter(
+                    gv, gf, gp, ovf_g = gfilter(
                         cvs_s.reshape(-1), cfs_s.reshape(-1),
-                        cps_s.reshape(-1), visited, self.cap_g,
+                        cps_s.reshape(-1),
                     )
                     overflow_g = overflow_g | ovf_g
                     gvs.append(gv)
@@ -1721,17 +1828,29 @@ class JaxChecker:
         # the level-dedup sort shape: part of the sanitizer's per-level
         # shape signature (a new lane count legitimately recompiles it)
         self._san_lanes = n_lanes + pad
-        n_new_dev, new_fps, new_payload = _level_dedup(
-            jnp.concatenate(lvs), jnp.concatenate(lfs), jnp.concatenate(lps),
-            visited,
-        )
+        if use_hs:
+            # fused probe-and-insert: uniqueness + visited filter + store
+            # update in one O(lanes) program — the slab comes back as a
+            # PENDING update so the overflow-redo loop can discard it
+            (n_new_dev, new_fps, new_payload, slab2,
+             ovf_h) = _level_dedup_hash(
+                jnp.concatenate(lvs), jnp.concatenate(lfs),
+                jnp.concatenate(lps), hslab,
+            )
+            self._hs_pending = slab2
+        else:
+            ovf_h = jnp.zeros((), bool)
+            n_new_dev, new_fps, new_payload = _level_dedup(
+                jnp.concatenate(lvs), jnp.concatenate(lfs),
+                jnp.concatenate(lps), visited,
+            )
         # ONE host sync for the level's control state
-        n_new, ab, ovf, ovf_g, mult_np = jax.device_get(
-            (n_new_dev, abort_at, overflow, overflow_g, mult_acc)
+        n_new, ab, ovf, ovf_g, ovf_hs, mult_np = jax.device_get(
+            (n_new_dev, abort_at, overflow, overflow_g, ovf_h, mult_acc)
         )
         return (
             int(n_new), new_fps, new_payload, int(ab), bool(ovf), bool(ovf_g),
-            mult_np,
+            bool(ovf_hs), mult_np,
         )
 
     # -- external-store path: per-group host filtering ---------------------
@@ -1860,7 +1979,8 @@ class JaxChecker:
                 # budget and redo the level cleanly.  Completed groups'
                 # partials survive the redo — their candidate sets are
                 # budget-independent (see _load_partials)
-                return (0, None, None, int(ab), bool(ovf_h), False, mult_np)
+                return (0, None, None, int(ab), bool(ovf_h), False, False,
+                        mult_np)
             n_u = int(n_u)
             gv_np = np.asarray(gv_np[:n_u])
             gf_np = np.asarray(gf_np[:n_u])
@@ -1894,7 +2014,7 @@ class JaxChecker:
         o = np.argsort(new_pay)
         return (len(new_fps), np.ascontiguousarray(new_fps[o]),
                 np.ascontiguousarray(new_pay[o]), int(BIG), False, False,
-                mult_np)
+                False, mult_np)
 
     def _save_partial(self, ckdir, level, gi, hv, hf, hp, mult, n_f):
         os.makedirs(ckdir, exist_ok=True)
@@ -2037,7 +2157,10 @@ class JaxChecker:
                 ck = self._resume_from_deltas(resume_from)
             else:
                 ck = self._load_checkpoint(
-                    resume_from, device_visited=self.host_store is None
+                    resume_from,
+                    device_visited=(
+                        self.host_store is None and not self.use_hashstore
+                    ),
                 )
                 self._check_fp_def(ck["fp_def"], resume_from)
                 if self.host_store is not None:
@@ -2049,6 +2172,14 @@ class JaxChecker:
                     self._seed_host_store(ck.pop("visited"))
                     ck["visited"] = jnp.full((64,), SENT, U64)
                     ck["frontier"] = [ck["frontier"]]
+                elif self.use_hashstore:
+                    # a sorted-store monolith resumes onto the hash slab:
+                    # its visited array is the fingerprint set — rebuild
+                    # host-side (insert_np), one device_put of the slab
+                    self.hstore = hashstore.DeviceHashStore.from_fps(
+                        np.asarray(ck.pop("visited"))
+                    )
+                    ck["visited"] = jnp.full((64,), SENT, U64)
             frontier, visited = ck["frontier"], ck["visited"]
             n_f, distinct, generated = ck["n_f"], ck["distinct"], ck["generated"]
             depth, level_sizes, trace_levels = (
@@ -2061,6 +2192,11 @@ class JaxChecker:
             fv, _ff = self._fp_states(st0)
             if self.host_store is not None:
                 self.host_store.insert(np.asarray(fv.astype(U64)))
+                visited = jnp.full((64,), SENT, U64)
+            elif self.use_hashstore:
+                self.hstore = hashstore.DeviceHashStore.from_fps(
+                    np.asarray(jax.device_get(fv.astype(U64)))
+                )
                 visited = jnp.full((64,), SENT, U64)
             else:
                 visited = jnp.sort(
@@ -2118,7 +2254,14 @@ class JaxChecker:
             if self.presize and len(level_sizes) > PRESIZE_MIN_LEVELS:
                 self._update_presize(level_sizes, distinct, max_depth,
                                      frontier)
-                if (self.host_store is None
+                if self.host_store is None and self.use_hashstore:
+                    # slab presize: one rehash up to the forecast entry
+                    # count, so deep runs compile one probe shape per
+                    # pow2 magnitude instead of overflow-redoing levels
+                    ent = getattr(self, "_presize_entries", 0)
+                    if ent:
+                        self.hstore.reserve(int(ent * 1.1))
+                elif (self.host_store is None
                         and self._presize_vcap > visited.shape[0]):
                     # SENT-pad the sorted store up front so its shape is
                     # pinned for the rest of the run (SENT sorts last, so
@@ -2133,17 +2276,23 @@ class JaxChecker:
             # --- expand + compact-then-dedup (device), fused level fetch -
             while True:
                 (n_new, new_fps, new_payload, abort_at, overflow, overflow_g,
-                 level_mult) = self._expand_level(
+                 overflow_h, level_mult) = self._expand_level(
                     frontier, n_f, visited,
                     ckdir=checkpoint_dir if checkpoint_every else None,
                     depth=depth,
                 )
-                if not (overflow or overflow_g):
+                if not (overflow or overflow_g or overflow_h):
                     break
                 # a lane budget overflowed: grow it and redo the level
                 # (pure computation, rare).  cap_x is baked into the traced
                 # chunk program, so re-jit; cap_g is a static jit arg and
                 # retraces on its own.
+                if overflow_h:
+                    # a probe window filled: rehash into a bigger slab and
+                    # redo against the ORIGINAL slab (the pending update
+                    # is discarded — the kernels are functional)
+                    self._hs_pending = None
+                    self.hstore.grow()
                 if overflow:
                     # half-step growth ({2^k, 3*2^(k-1)}): a doubled cap_x
                     # inflates every downstream lane count (group filter,
@@ -2226,7 +2375,17 @@ class JaxChecker:
             level_sizes.append(n_new)
             depth += 1
 
-            if self.host_store is None:
+            if self.host_store is None and self.use_hashstore:
+                # the fused probe-and-insert already built the updated
+                # slab — adopt the pending copy (no merge, no re-sort)
+                # and grow BETWEEN levels when the next level's worst
+                # case (~2x this one) would cross the 1/2 load line, so
+                # mid-level overflow redos stay the rare backstop
+                self.hstore.adopt(self._hs_pending, n_new)
+                self._hs_pending = None
+                if self.hstore.need_grow(extra=2 * n_new):
+                    self.hstore.grow()
+            elif self.host_store is None:
                 # merge, then trim the store to a pow4 capacity >= distinct;
                 # new_fps is survivor-compacted, so slicing keeps every
                 # real fingerprint and bounds the sort input.  The presize
@@ -2259,9 +2418,15 @@ class JaxChecker:
                     fcap = tuple(_seg_rows(s) for s in frontier)
                 else:
                     fcap = frontier.voted_for.shape[0]
+                if self.host_store is not None:
+                    vshape = 0
+                elif self.use_hashstore:
+                    vshape = self.hstore.cap
+                else:
+                    vshape = visited.shape[0]
                 sig = (
                     fcap,
-                    0 if self.host_store is not None else visited.shape[0],
+                    vshape,
                     int(new_payload.shape[0]),
                     self.cap_x, self.cap_g, self.cap_m,
                     getattr(self, "_san_lanes", 0),
@@ -2305,16 +2470,40 @@ class JaxChecker:
             # only gates whether checkpointing happens at all).
             if checkpoint_dir and checkpoint_every:
                 # with a host store the device fps are pre-filter — the
-                # log must hold exactly the level's NEW fingerprints
-                fps_np = (
-                    fps_host
-                    if fps_host is not None
-                    else np.asarray(new_fps[:n_new])
-                ).astype(np.uint64)
+                # log must hold exactly the level's NEW fingerprints.
+                # Device slice at a POW2-quantized width, trim host-side:
+                # a raw [:n_new] slice compiled one eager program per
+                # level — latent under the sorted store (its per-level
+                # capacity steps declared shape events that excused the
+                # compile), surfaced by the hash slab's constant shape
+                if fps_host is not None:
+                    fps_np = fps_host.astype(np.uint64)
+                else:
+                    w = min(new_fps.shape[0],
+                            max(_pow2(n_new), self.chunk))
+                    fps_np = np.asarray(
+                        new_fps[:w]
+                    )[:n_new].astype(np.uint64)
                 self._save_delta(
                     checkpoint_dir, depth, pidx_np, slot_np, fps_np,
                     level_mult, n_new,
                 )
+                # slab snapshot next to the delta log (versioned; resume
+                # loads it when it matches, else rebuilds from the
+                # replayed fps — never the source of truth).  The dump
+                # fetches + rewrites the WHOLE slab, so it runs on the
+                # shared size-aware interval (hashstore.dump_interval /
+                # TLA_RAFT_SLAB_DUMP; 0 = off).
+                dump_every = (
+                    hashstore.dump_interval(self.hstore.cap * 8)
+                    if self.use_hashstore else 0
+                )
+                if (self.use_hashstore and dump_every
+                        and depth % dump_every == 0):
+                    self.hstore.dump(
+                        os.path.join(checkpoint_dir, "hslab.npz"),
+                        depth, int(self.orbit),
+                    )
                 if self.host_store is not None:
                     # the level's per-group partials are superseded by its
                     # delta record (only the in-flight level ever has any)

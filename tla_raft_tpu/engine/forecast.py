@@ -152,8 +152,13 @@ def per_device_forecast(level_sizes, distinct: int,
     else a dict of per-device row forecasts:
 
       peak_rows:  largest per-level new-state share one device owns
-      final_rows: final distinct-state share one device owns (sieve /
-                  store-cache sizing)
+      final_rows: final distinct-state share one device owns — the
+                  entry forecast for the per-owner membership
+                  structures: the deep sieve cache and the hash-slab
+                  visited shards (ops/hashstore.py slab_rows sizes a
+                  slab from this at the quantized <=1/2 load factor;
+                  8 B/slot => ~16 B per forecast entry against the
+                  byte budget)
       budget:     TLA_RAFT_PRESIZE_BYTES, passed through for the same
                   clamping the engines already apply
     """

@@ -186,7 +186,14 @@ def insert_sharded(stores: list, fps: np.ndarray) -> int:
 
     D = len(stores)
     fps = np.ascontiguousarray(fps, np.uint64)
-    shares = [np.sort(fps[fps % np.uint64(D) == o]) for o in range(D)]
+    shares = [fps[fps % np.uint64(D) == o] for o in range(D)]
+    if len(fps) and not bool(np.all(fps[1:] >= fps[:-1])):
+        # both resume callers pass np.unique output (sorted), and the
+        # owner filter of a sorted array stays sorted — the O(n log n)
+        # per-share re-sort only runs for unsorted inputs, so slab- or
+        # log-sourced rebuilds skip the store-insert path's last
+        # host-side sort entirely (the single-CPU rebuild tail)
+        shares = [np.sort(s) for s in shares]
 
     def one(o):
         return int(stores[o].insert(shares[o]).sum()) if len(shares[o]) else 0

@@ -133,6 +133,10 @@ class ExchangeMeter:
             level=level, a2a_bytes=0, host_bytes=0,
             raw_a2a_bytes=0, raw_host_bytes=0,
             n_candidates=0, n_sieved=0, n_unique=0,
+            # None = no packing decision was made this level (paths
+            # that never delta-pack — all_gather, the plain hosted
+            # exchange); the deep path records True/False explicitly
+            packed=None,
         )
 
     def add(self, **kw):
@@ -140,8 +144,26 @@ class ExchangeMeter:
         for k, v in kw.items():
             self._cur[k] += int(v)
 
+    def note_packed(self, packed: bool):
+        """Record whether the level's fp stream went out delta-packed.
+
+        ``packed=False`` means the packing fallback fired: the packed
+        form (plus header) was NOT smaller, so the raw u64 stream was
+        sent instead.  The level's host leg then has no hypothetical
+        uncompressed equivalent — what was sent IS the uncompressed
+        form — so ``end_level`` floors the raw-host mirror at the
+        actual host bytes and per-level reduction can never read < 1
+        (the BENCH_r06 levels 1-2 "reduction 0.21-0.56" artifact was
+        exactly quantum padding billed against a live-lane mirror)."""
+        assert self._cur is not None
+        self._cur["packed"] = bool(packed)
+
     def end_level(self) -> dict:
         cur, self._cur = self._cur, None
+        if cur["packed"] is False:  # None = packing never considered
+            cur["raw_host_bytes"] = max(
+                cur["raw_host_bytes"], cur["host_bytes"]
+            )
         exchanged = cur["a2a_bytes"] + cur["host_bytes"]
         raw = cur["raw_a2a_bytes"] + cur["raw_host_bytes"]
         cur["exchanged_bytes"] = exchanged
@@ -163,7 +185,7 @@ class ExchangeMeter:
             per_level=[
                 {k: lv[k] for k in (
                     "level", "exchanged_bytes", "raw_bytes", "reduction",
-                    "n_candidates", "n_sieved", "n_unique",
+                    "n_candidates", "n_sieved", "n_unique", "packed",
                 )}
                 for lv in self.levels
             ],

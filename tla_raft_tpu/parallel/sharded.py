@@ -57,6 +57,7 @@ from ..analysis import sanitize as graft_sanitize
 from ..config import RaftConfig
 from ..engine.bfs import _compact_payloads
 from ..engine.invariants import resolve_invariant_kernel
+from ..ops import hashstore
 from ..models.raft import RaftState, init_batch, to_oracle
 from ..ops.successor import get_kernel
 from .exchange import (
@@ -259,6 +260,7 @@ class ShardedChecker:
         compress: bool = True,
         scap: int = 1 << 12,
         scap_max: int = 1 << 22,
+        use_hashstore: bool | None = None,
     ):
         assert exchange in ("all_to_all", "all_gather")
         # deep-sweep tier: the frontier itself is sharded 1/D — each
@@ -282,6 +284,18 @@ class ShardedChecker:
                 raise ValueError("seg_rows must be even")
         self.deep = deep
         self.seg_rows = seg_rows
+        # open-addressing fingerprint slabs (ops/hashstore.py) for the
+        # two mesh-side membership structures keyed fp % D: the owner
+        # visited shards of the plain all_to_all mode (replacing the
+        # per-level lexsort + searchsorted + sorted merge) and the deep
+        # mode's pre-routing sieve cache (the sieve becomes a plain
+        # probe; updates become O(1) inserts instead of a sort-merge).
+        # Default ON; TLA_RAFT_HASHSTORE=0 / --no-hashstore reverts.
+        # all_gather keeps its replicated sorted store (its dedup IS a
+        # global sort — there is no probe structure to replace).
+        if use_hashstore is None:
+            use_hashstore = hashstore.enabled_by_env()
+        self.use_hashstore = bool(use_hashstore) and exchange == "all_to_all"
         self.sieve = sieve
         self.compress = compress
         self.scap = scap
@@ -601,22 +615,38 @@ class ShardedChecker:
 
         # --- owner-side dedup vs the store shard -------------------------
         qv, qf, qp = rv.reshape(-1), rf.reshape(-1), rp.reshape(-1)
-        qorder = jnp.lexsort((qp, qf, qv))
-        qsv = qv[qorder]
-        qfirst = jnp.concatenate([jnp.ones((1,), bool), qsv[1:] != qsv[:-1]])
-        pos = jnp.searchsorted(visited, qsv)
-        qhit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == qsv
-        qnew = qfirst & (qsv != SENT) & ~qhit
-        n_own_new = qnew.sum()
-        # update the shard (sorted merge, fixed capacity)
-        vcount = (visited != SENT).sum()
-        overflow_v = vcount + n_own_new > visited.shape[0]
-        upd = jnp.sort(
-            jnp.concatenate([visited, jnp.where(qnew, qsv, SENT)])
-        )[: visited.shape[0]]
-        # verdict bits back to origins, aligned to the recv layout
-        # (inverse-permutation gather, not a scatter)
-        verdict = qnew[jnp.argsort(qorder)]
+        if self.use_hashstore:
+            # one fused probe-and-insert: uniqueness, membership AND the
+            # shard update — no lexsort over the recv lanes, no binary
+            # search against the shard, no whole-shard re-sort.  The
+            # min-(fp_full, payload) representative matches the lexsort
+            # path's first-occurrence choice exactly (group-min lemma),
+            # and verdicts come back already in recv-lane order (the
+            # sorted path needs an inverse-permutation gather).  On
+            # overflow the driver discards the level and grows the slab.
+            upd, verdict, n_own_new, ovf_h = hashstore.probe_and_insert_impl(
+                visited, qv, qf, qp
+            )
+            overflow_v = ovf_h | ((upd != SENT).sum() * 2 > visited.shape[0])
+        else:
+            qorder = jnp.lexsort((qp, qf, qv))
+            qsv = qv[qorder]
+            qfirst = jnp.concatenate(
+                [jnp.ones((1,), bool), qsv[1:] != qsv[:-1]]
+            )
+            pos = jnp.searchsorted(visited, qsv)
+            qhit = visited[jnp.clip(pos, 0, visited.shape[0] - 1)] == qsv
+            qnew = qfirst & (qsv != SENT) & ~qhit
+            n_own_new = qnew.sum()
+            # update the shard (sorted merge, fixed capacity)
+            vcount = (visited != SENT).sum()
+            overflow_v = vcount + n_own_new > visited.shape[0]
+            upd = jnp.sort(
+                jnp.concatenate([visited, jnp.where(qnew, qsv, SENT)])
+            )[: visited.shape[0]]
+            # verdict bits back to origins, aligned to the recv layout
+            # (inverse-permutation gather, not a scatter)
+            verdict = qnew[jnp.argsort(qorder)]
         back = jax.lax.all_to_all(
             verdict.reshape(D, cap_r), "d", 0, 0, tiled=True
         ).reshape(D, cap_r)
@@ -970,9 +1000,14 @@ class ShardedChecker:
         if self.sieve:
             # drop candidates this device routed in a PREVIOUS level:
             # every routed fingerprint was inserted into the store by
-            # that level's filter, so the drop is provably-visited-only
-            pos = jnp.searchsorted(sieve, cv)
-            hit = sieve[jnp.clip(pos, 0, sieve.shape[0] - 1)] == cv
+            # that level's filter, so the drop is provably-visited-only.
+            # Hash mode: a depth-bounded O(1) probe instead of the
+            # ~log2(scap) gather rounds of binary search per candidate.
+            if self.use_hashstore:
+                hit = hashstore.probe_impl(sieve, cv)
+            else:
+                pos = jnp.searchsorted(sieve, cv)
+                hit = sieve[jnp.clip(pos, 0, sieve.shape[0] - 1)] == cv
             cv = jnp.where(hit, SENT, cv)
             cf = jnp.where(hit, SENT, cf)
             cp = jnp.where(hit, I64(-1), cp)
@@ -1212,6 +1247,16 @@ class ShardedChecker:
         overflow = jax.lax.psum((n_u > scap).astype(I32), "d") > 0
         return out, overflow
 
+    def _deep_sieve_insert_body(self, sieve, cv):
+        """Hash-slab sieve update: O(candidates) probe-and-insert
+        instead of the sort-merge of ``_deep_sieve_merge_body``.  Lanes
+        whose probe window is full are SKIPPED (subset semantics — a
+        sieve miss is never wrong) and the psum'd overflow flag makes
+        the driver grow/rehash scap for the next level."""
+        sieve2, _n, ovf = hashstore.insert_only_impl(sieve, cv)
+        overflow = jax.lax.psum(ovf.astype(I32), "d") > 0
+        return sieve2, overflow
+
     # -- deep-mode program cache ------------------------------------------
 
     def _dprog(self, key, build):
@@ -1323,10 +1368,16 @@ class ShardedChecker:
         return self._dprog(("rp", Rq, n_out, self.cap_c_deep), build)
 
     def _deep_sv(self):
+        body = (
+            self._deep_sieve_insert_body
+            if self.use_hashstore
+            else self._deep_sieve_merge_body
+        )
+
         def build():
             return jax.jit(
                 _shard_map(
-                    self._deep_sieve_merge_body,
+                    body,
                     self.mesh,
                     (P("d"), P("d")),
                     (P("d"), P()),
@@ -1408,12 +1459,40 @@ class ShardedChecker:
         arr = np.asarray(
             jax.device_get(self._sieve_cache)
         ).reshape(self.D, self.scap)
-        pad = np.full((self.D, new_scap - self.scap), SENT)
+        if self.use_hashstore:
+            # hash slabs rehash on growth (slot homes move with the
+            # capacity mask — padding would orphan every cached entry)
+            new = hashstore.rebuild_np(arr, new_scap)
+        else:
+            pad = np.full((self.D, new_scap - self.scap), SENT)
+            new = np.concatenate([arr, pad], axis=1)
         self.scap = new_scap
         self._sieve_cache = jax.device_put(
-            jnp.asarray(np.concatenate([arr, pad], axis=1)).reshape(-1),
+            jnp.asarray(new).reshape(-1),
             NamedSharding(self.mesh, P("d")),
         )
+        self._dp.clear()
+
+    def _load_sieve_slab(self, ckdir, depth, shard):
+        """Adopt a checkpointed sieve slab if (version, depth, D, mode)
+        all match; silently keep the empty sieve otherwise."""
+        path = os.path.join(ckdir, "sieve_slab.npz")
+        if not os.path.exists(path):
+            return
+        try:
+            z = np.load(path)
+            ver, d, Dz, rows, hs = (int(x) for x in z["meta"])
+            slab = np.asarray(z["slab"], np.uint64)
+        except (OSError, ValueError, KeyError):
+            return
+        if (
+            ver != hashstore.SLAB_VERSION or d != depth or Dz != self.D
+            or hs != int(self.use_hashstore)
+            or slab.shape[0] != Dz * rows
+        ):
+            return
+        self.scap = rows
+        self._sieve_cache = jax.device_put(jnp.asarray(slab), shard)
         self._dp.clear()
 
     def _deep_level(self, segments, n_f_np, depth):
@@ -1519,21 +1598,28 @@ class ShardedChecker:
         # the largest owner's live bytes), dispatched from the main
         # thread; then the D store inserts — the serial single-CPU
         # level tail of the resident design — run concurrently in the
-        # pool (the ctypes insert releases the GIL)
-        if self.compress:
-            qb = min(packed_quantum(max(int(totals.max()), 1)), cap8)
+        # pool (the ctypes insert releases the GIL).
+        #
+        # Packing fallback: the delta/varint form wins only once levels
+        # carry enough fingerprints to amortize its per-owner quanta —
+        # at tiny levels the packed stream + nibble header is BIGGER
+        # than the raw u64 prefix (BENCH_r06 per_level reduction
+        # 0.21-0.56 on levels 1-2), so compare the two quantized fetch
+        # sizes and ship whichever is smaller, recording packed=False
+        # in the ledger when the raw form goes out.
+        qf = min(packed_quantum(max(max_nu, 1)), cap_acc)
+        qb = min(packed_quantum(max(int(totals.max()), 1)), cap8)
+        qn = min(packed_quantum(max((max_nu + 1) // 2, 1)), capnib)
+        packed_ok = self.compress and (qb + qn) < qf * 8
+        if packed_ok:
             st_all = np.asarray(jax.device_get(
                 self._deep_prefix(cap8, qb)(fin.stream)
             )).reshape(D, qb)
-            qn = min(
-                packed_quantum(max((max_nu + 1) // 2, 1)), capnib
-            )
             nb_all = np.asarray(jax.device_get(
                 self._deep_prefix(capnib, qn)(fin.nib)
             )).reshape(D, qn)
             fetch_bytes = D * (qb + qn)
         else:
-            qf = min(packed_quantum(max(max_nu, 1)), cap_acc)
             uq_all = np.asarray(jax.device_get(
                 self._deep_prefix(cap_acc, qf)(uq)
             )).reshape(D, qf)
@@ -1544,7 +1630,7 @@ class ShardedChecker:
             n_o = int(n_us[o])
             if n_o == 0:
                 return
-            if self.compress:
+            if packed_ok:
                 fps = unpack_fp_deltas(st_all[o], nb_all[o], n_o)
             else:
                 fps = uq_all[o][:n_o]
@@ -1554,6 +1640,7 @@ class ShardedChecker:
             bits_np[o, : len(pb)] = pb[:vq]
 
         list(self._io_pool.map(insert_one, range(D)))
+        meter.note_packed(packed_ok)
         meter.add(host_bytes=fetch_bytes + D * vq + 16 * D)
         vb = jax.device_put(jnp.asarray(bits_np.reshape(-1)), shard)
         ver = self._deep_ver(Rq, vq)(rv3, rf3, vb)
@@ -1759,6 +1846,10 @@ class ShardedChecker:
             level_sizes = ck["level_sizes"]
             trace_levels = ck["trace_levels"]
             mult_slots_total = np.asarray(ck["mult_slots"], np.int64)
+            # restore the serialized sieve-cache slab when it matches
+            # the resume point (pure optimization — an empty sieve is
+            # always correct, just less effective for a few levels)
+            self._load_sieve_slab(resume_from, depth, shard)
         else:
             segments = [jax.device_put(init_batch(cfg, D * seg), shard)]
             n_f_np = np.array([1] + [0] * (D - 1), np.int64)
@@ -1916,9 +2007,22 @@ class ShardedChecker:
                     n_new_local=n_f_np.copy(),
                     mult_slots=out["mult_slots"],
                 )
+                sieve_np = None
+                # shared size-aware snapshot cadence (the dump is a
+                # resume optimization, not the source of truth)
+                dump_every = hashstore.dump_interval(self.D * self.scap * 8)
+                if (self.sieve and self.scap and dump_every
+                        and depth % dump_every == 0):
+                    # intended slab snapshot (the fetch is O(D*scap)):
+                    # fetched on the MAIN thread (workers never
+                    # dispatch), written by the deferred tail writer
+                    # with the mdelta record
+                    sieve_np = np.asarray(
+                        jax.device_get(self._sieve_cache)
+                    )
                 ck_fut = self._ck_pool.submit(
                     self._save_mdelta, checkpoint_dir, depth, ns,
-                    capf_prev,
+                    capf_prev, sieve_np,
                 )
         join_ck()
         return CheckResult(
@@ -2002,9 +2106,29 @@ class ShardedChecker:
     # * **monolith** (``latest.npz``, back-compat): full frontier + store
     #   in one file.
 
-    def _save_mdelta(self, ckdir, depth, out, cap_f):
-        """Append one level's delta record (compact layout prefixes)."""
+    def _save_mdelta(self, ckdir, depth, out, cap_f, sieve_np=None):
+        """Append one level's delta record (compact layout prefixes).
+
+        ``sieve_np`` (deep mode): the level-end sieve-cache slab,
+        serialized VERSIONED alongside the segment-quantized frontier
+        records so a resumed deep run keeps its sieve effectiveness
+        instead of re-learning the visited set from zero.  The slab is
+        an optimization cache — resume validates (version, depth, D,
+        mode) and silently starts empty on any mismatch."""
         os.makedirs(ckdir, exist_ok=True)
+        if sieve_np is not None:
+            rows = sieve_np.shape[0] // self.D
+            tmp = os.path.join(ckdir, ".tmp_sieve_slab.npz")
+            np.savez(
+                tmp,
+                slab=sieve_np,
+                meta=np.asarray(
+                    [hashstore.SLAB_VERSION, depth, self.D, rows,
+                     int(self.use_hashstore)],
+                    np.int64,
+                ),
+            )
+            os.replace(tmp, os.path.join(ckdir, "sieve_slab.npz"))
         gpidx = np.asarray(out.gpidx).astype(np.int64)
         slots = np.asarray(out.slots).astype(np.int64)
         n_local = np.asarray(out.n_new_local).astype(np.int64).reshape(-1)
@@ -2230,10 +2354,13 @@ class ShardedChecker:
             per_shard = [np.sort(fps[fps % np.uint64(D) == o]) for o in range(D)]
             need = max(len(s) for s in per_shard)
             vcap = max(self.vcap, 1 << (2 * need - 1).bit_length())
-            vis = np.full((D, vcap), np.uint64(0xFFFFFFFFFFFFFFFF))
-            for o, s in enumerate(per_shard):
-                vis[o, : len(s)] = s
-            vis = np.sort(vis, axis=1)
+            if self.use_hashstore:
+                vis = hashstore.rebuild_np(per_shard, vcap)
+            else:
+                vis = np.full((D, vcap), np.uint64(0xFFFFFFFFFFFFFFFF))
+                for o, s in enumerate(per_shard):
+                    vis[o, : len(s)] = s
+                vis = np.sort(vis, axis=1)
             self.vcap = vcap
             visited = jax.device_put(jnp.asarray(vis).reshape(-1), shard)
         else:
@@ -2288,14 +2415,23 @@ class ShardedChecker:
                 if k.startswith("st_")
             }
         )
+        vis_np = z["visited"]
+        if self.use_hashstore and self.exchange == "all_to_all":
+            # legacy monoliths hold sorted shards; rebuild the hash
+            # slabs host-side at the same per-shard capacity (growing
+            # if the sorted shard ran hotter than the 1/2 load line)
+            arr = np.asarray(vis_np).reshape(D, -1)
+            need = int(max((arr[o] != SENT).sum() for o in range(D)))
+            vcap = max(arr.shape[1], hashstore.slab_rows(need))
+            vis_np = hashstore.rebuild_np(arr, vcap).reshape(-1)
         visited = jax.device_put(
-            jnp.asarray(z["visited"]),
+            jnp.asarray(vis_np),
             shard if self.exchange == "all_to_all" else repl,
         )
         if self.exchange == "all_to_all":
-            self.vcap = z["visited"].shape[0] // D
+            self.vcap = vis_np.shape[0] // D
         else:
-            self.vcap = z["visited"].shape[0]
+            self.vcap = vis_np.shape[0]
         trace_levels = [
             (z[f"trace_p{i}"], z[f"trace_s{i}"])
             for i in range(int(z["n_trace"][0]))
@@ -2395,8 +2531,13 @@ class ShardedChecker:
                 visited = None
             elif self.exchange == "all_to_all":
                 vis = np.full((D, self.vcap), np.uint64(0xFFFFFFFFFFFFFFFF))
-                vis[int(fp0 % D), 0] = fp0
-                vis = np.sort(vis, axis=1)
+                if self.use_hashstore:
+                    hashstore.insert_np(
+                        vis[int(fp0 % D)], np.asarray([fp0], np.uint64)
+                    )
+                else:
+                    vis[int(fp0 % D), 0] = fp0
+                    vis = np.sort(vis, axis=1)
                 visited = jax.device_put(jnp.asarray(vis).reshape(-1), shard)
             else:
                 vis = np.full(self.vcap, np.uint64(0xFFFFFFFFFFFFFFFF))
@@ -2423,8 +2564,16 @@ class ShardedChecker:
                 )
 
         def grow_visited(v, new_vcap):
-            """Pad every store shard (sorted, SENT tail) to a new capacity."""
+            """Grow every store shard: SENT-pad (sorted mode) or rehash
+            into a bigger slab (hash mode — slot homes move with the
+            capacity mask, so padding would orphan every entry)."""
             arr = np.asarray(v).reshape(D, -1)
+            if self.use_hashstore:
+                out = hashstore.rebuild_np(arr, new_vcap)
+                self.vcap = new_vcap
+                return jax.device_put(
+                    jnp.asarray(out).reshape(-1), shard
+                )
             pad = np.full((D, new_vcap - arr.shape[1]), np.uint64(SENT))
             self.vcap = new_vcap
             return jax.device_put(
