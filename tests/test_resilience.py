@@ -1,0 +1,493 @@
+"""Crash-safe checking: the fault-injection crash matrix.
+
+Every registered fault site is exercised by killing a REAL subprocess
+checker mid-write with the deterministic fault plan
+(``TLA_RAFT_FAULT``), resuming with ``--recover``, and requiring the
+resumed run to land on the uninterrupted run's ``distinct`` / ``depth``
+/ ``level_sizes`` EXACTLY — the bit-identical-recovery contract of
+ISSUE 4.  Latent corruption (byte flips, torn writes) goes through the
+same quarantine-and-truncate healing in-process, where the cheaper
+setup lets us also assert on WHAT was quarantined.
+
+Configs: the (2,1,1,1) full fixpoint (50 states, depth 12 — the same
+golden the CLI suite pins) and a (3,1,2,1) prefix, single-device and
+mesh-deep.  Heavier matrix rows carry ``@pytest.mark.slow``.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+
+from tla_raft_tpu import resilience
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.oracle import OracleChecker
+from tla_raft_tpu.parallel import ShardedChecker, make_mesh
+from tla_raft_tpu.resilience import faults, manifest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+S2 = RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1)
+S3121 = RaftConfig(n_servers=3, n_vals=1, max_election=2, max_restart=1)
+
+CFG_2111 = textwrap.dedent(
+    """
+    CONSTANTS
+        MaxTerm = 3
+        MaxRestart = 1
+        MaxElection = 1
+        Follower = Follower
+        Candidate = Candidate
+        Leader = Leader
+        None = None
+        VoteReq = VoteReq
+        VoteResp = VoteResp
+        AppendReq = AppendReq
+        AppendResp = AppendResp
+        s1 = s1
+        s2 = s2
+        Servers = {s1, s2}
+        v1 = v1
+        Vals = {v1}
+
+    SYMMETRY symmServers
+    VIEW view
+
+    INIT Init
+    NEXT Next
+
+    INVARIANT
+    Inv
+    """
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    faults.reset()
+    resilience.clear_preempt()
+
+
+@pytest.fixture(scope="module")
+def golden_s2():
+    return OracleChecker(S2).run()
+
+
+def _cfg_file(tmp_path):
+    p = tmp_path / "Tiny.cfg"
+    p.write_text(CFG_2111)
+    return str(p)
+
+
+def _run_cli(args, fault=None, devices=1, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices}"
+    )
+    if fault is not None:
+        env["TLA_RAFT_FAULT"] = fault
+    else:
+        env.pop("TLA_RAFT_FAULT", None)
+    return subprocess.run(
+        [sys.executable, "-m", "tla_raft_tpu.check", *args],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def _json_line(proc):
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    raise AssertionError(
+        f"no JSON summary in output:\n{proc.stdout}\n{proc.stderr}"
+    )
+
+
+def _flip_byte(path):
+    sz = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.seek(sz // 2)
+        b = fh.read(1)
+        fh.seek(sz // 2)
+        fh.write(bytes([b[0] ^ 0xFF]))
+
+
+# -- the subprocess crash matrix ------------------------------------------
+#
+# One kill per registered writer site, then --recover: the resumed run
+# must reproduce the uninterrupted (2,1,1,1) fixpoint bit-exactly.
+
+SINGLE_SITES = [
+    "delta.tmp:kill@3",       # orphaned .tmp_delta_*, no record
+    "delta.commit:kill@3",    # renamed but unmanifested record
+    "manifest.commit:kill@2",  # manifest tmp orphaned, entry lost
+]
+SINGLE_SITES_SLOW = [
+    "hslab.commit:kill@2",    # unmanifested slab snapshot
+    "level.start:kill@6",     # clean between-level kill
+    "delta.tmp:torn@4",       # torn tmp: swept, never renamed
+]
+
+
+def _kill_recover_cycle(tmp_path, golden, site, extra=(), devices=1):
+    cfg = _cfg_file(tmp_path)
+    ck = str(tmp_path / "ck")
+    base = ["--config", cfg, "--checkpoint-dir", ck, "--log", "-",
+            "--json", *extra]
+    first = _run_cli(base, fault=site, devices=devices)
+    if "kill" in site:
+        assert first.returncode not in (0, 1, 2, 3), (
+            f"fault {site} did not kill the run:\n{first.stdout}"
+        )
+    rec = _run_cli(base + ["--recover", ck], devices=devices)
+    assert rec.returncode == 0, rec.stdout + rec.stderr
+    got = _json_line(rec)
+    assert got["ok"]
+    assert got["distinct"] == golden.distinct
+    assert got["depth"] == golden.depth
+    assert got["level_sizes"] == list(golden.level_sizes)
+    # no tmp litter survives the healed resume
+    assert not glob.glob(os.path.join(ck, ".tmp_*"))
+    return ck
+
+
+@pytest.mark.parametrize("site", SINGLE_SITES)
+def test_crash_matrix_single_device(tmp_path, golden_s2, site):
+    _kill_recover_cycle(tmp_path, golden_s2, site, extra=["--chunk", "64"])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", SINGLE_SITES_SLOW)
+def test_crash_matrix_single_device_slow(tmp_path, golden_s2, site):
+    _kill_recover_cycle(tmp_path, golden_s2, site, extra=["--chunk", "64"])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "site", ["partial.tmp:kill@3", "partial.commit:kill@3"]
+)
+def test_crash_matrix_partial_writer(tmp_path, golden_s2, site):
+    """The intra-level partial writer (external-store path) rides the
+    same atomic commit: kills at its sites recover bit-exactly."""
+    _kill_recover_cycle(
+        tmp_path, golden_s2, site,
+        extra=["--chunk", "64", "--fpstore-dir", str(tmp_path / "fps")],
+    )
+
+
+MESH_SITES = ["mdelta.commit:kill@3", "mdelta.tmp:kill@3"]
+MESH_SITES_SLOW = ["sieve.commit:kill@2", "manifest.commit:kill@3",
+                   "level.start:kill@6"]
+
+
+def _mesh_extra(tmp_path):
+    return [
+        "--chunk", "64", "--mesh", "4", "--mesh-deep", "--seg-rows", "8",
+        "--cap-x", "256", "--fpstore-dir", str(tmp_path / "fps"),
+    ]
+
+
+@pytest.mark.parametrize("site", MESH_SITES)
+def test_crash_matrix_mesh_deep(tmp_path, golden_s2, site):
+    _kill_recover_cycle(
+        tmp_path, golden_s2, site, extra=_mesh_extra(tmp_path), devices=4
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", MESH_SITES_SLOW)
+def test_crash_matrix_mesh_deep_slow(tmp_path, golden_s2, site):
+    _kill_recover_cycle(
+        tmp_path, golden_s2, site, extra=_mesh_extra(tmp_path), devices=4
+    )
+
+
+def test_supervise_relaunches_to_completion(tmp_path, golden_s2):
+    """--supervise N: the checker is SIGKILLed at every 5th delta commit
+    (the env plan re-arms in every child), yet the supervisor converges
+    because each incarnation makes durable progress."""
+    cfg = _cfg_file(tmp_path)
+    ck = str(tmp_path / "ck")
+    proc = _run_cli(
+        ["--config", cfg, "--chunk", "64", "--checkpoint-dir", ck,
+         "--supervise", "6", "--log", "-", "--json"],
+        fault="delta.commit:kill@5",
+        timeout=420,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    got = _json_line(proc)
+    assert got["distinct"] == golden_s2.distinct
+    assert got["level_sizes"] == list(golden_s2.level_sizes)
+    assert "relaunch" in proc.stderr
+
+
+# -- in-process healing / degradation / preemption ------------------------
+
+def test_delta_flip_quarantines_and_recovers(tmp_path, golden_s2):
+    """Latent corruption: a byte-flipped delta record fails its manifest
+    digest, is quarantined, and the run resumes from the surviving
+    prefix to the exact fixpoint."""
+    ck = str(tmp_path / "ck")
+    JaxChecker(S2, chunk=64).run(max_depth=7, checkpoint_dir=ck)
+    _flip_byte(os.path.join(ck, "delta_0006.npz"))
+    res = JaxChecker(S2, chunk=64).run(resume_from=ck, checkpoint_dir=ck)
+    assert res.distinct == golden_s2.distinct
+    assert res.level_sizes == golden_s2.level_sizes
+    q = os.listdir(os.path.join(ck, "quarantine"))
+    # the flipped record AND its orphaned deeper successor
+    assert "delta_0006.npz" in q and "delta_0007.npz" in q
+    # the healed directory's manifest watermark reflects the truncation
+    # before the resumed run re-records the lost levels
+    m = manifest.Manifest.load(ck)
+    assert m.watermark == 12
+
+
+def test_hslab_flip_falls_back_to_log_rebuild(tmp_path, golden_s2):
+    """A corrupt hash-slab snapshot is quarantined and the resume
+    rebuilds the store from the replayed log instead of crashing."""
+    ck = str(tmp_path / "ck")
+    JaxChecker(S2, chunk=64).run(max_depth=7, checkpoint_dir=ck)
+    assert os.path.exists(os.path.join(ck, "hslab.npz"))
+    _flip_byte(os.path.join(ck, "hslab.npz"))
+    res = JaxChecker(S2, chunk=64).run(resume_from=ck)
+    assert res.distinct == golden_s2.distinct
+    assert res.level_sizes == golden_s2.level_sizes
+    assert "hslab.npz" in os.listdir(os.path.join(ck, "quarantine"))
+
+
+def test_mdelta_tail_flip_truncates_and_resumes(tmp_path, golden_s2):
+    """The satellite fix: a corrupt mdelta TAIL record truncates-and-
+    resumes instead of raising 'mdelta log gap'."""
+    if len(jax.devices()) < 4:
+        pytest.skip("not enough virtual devices")
+    mesh = make_mesh(4)
+    ck = str(tmp_path / "ck")
+    ShardedChecker(
+        S2, mesh, cap_x=256, deep=True, seg_rows=8,
+        host_store_dir=str(tmp_path / "fps1"),
+    ).run(max_depth=5, checkpoint_dir=ck)
+    _flip_byte(os.path.join(ck, "mdelta_0005.npz"))
+    res = ShardedChecker(
+        S2, mesh, cap_x=256, deep=True, seg_rows=8,
+        host_store_dir=str(tmp_path / "fps2"),
+    ).run(resume_from=ck, checkpoint_dir=ck)
+    assert res.distinct == golden_s2.distinct
+    assert res.level_sizes == golden_s2.level_sizes
+    assert "mdelta_0005.npz" in os.listdir(os.path.join(ck, "quarantine"))
+
+
+def test_mdelta_interior_gap_stays_fatal(tmp_path):
+    """Only a TAIL gap heals; an interior hole (which the ordered writer
+    cannot produce) still refuses to resume."""
+    if len(jax.devices()) < 4:
+        pytest.skip("not enough virtual devices")
+    mesh = make_mesh(4)
+    ck = str(tmp_path / "ck")
+    ShardedChecker(
+        S2, mesh, cap_x=256, deep=True, seg_rows=8,
+        host_store_dir=str(tmp_path / "fps1"),
+    ).run(max_depth=5, checkpoint_dir=ck)
+    os.unlink(os.path.join(ck, "mdelta_0003.npz"))
+    with pytest.raises(ValueError, match="interior gap"):
+        ShardedChecker(
+            S2, mesh, cap_x=256, deep=True, seg_rows=8,
+            host_store_dir=str(tmp_path / "fps2"),
+        ).run(resume_from=ck)
+
+
+def test_tmp_sweep_fresh_and_resume(tmp_path, golden_s2):
+    """Satellite: orphaned .tmp_* files are swept before fresh runs and
+    on resume, so a killed writer can't poison glob ordering."""
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    (ck / ".tmp_delta_0001.npz").write_bytes(b"garbage")
+    res = JaxChecker(S2, chunk=64).run(max_depth=3, checkpoint_dir=str(ck))
+    assert res.depth == 3
+    assert not glob.glob(str(ck / ".tmp_*"))
+    (ck / ".tmp_delta_0099.npz").write_bytes(b"garbage")
+    (ck / ".tmp_partial_0001_00001.npz").write_bytes(b"garbage")
+    res = JaxChecker(S2, chunk=64).run(resume_from=str(ck))
+    assert res.distinct == golden_s2.distinct
+    assert not glob.glob(str(ck / ".tmp_*"))
+
+
+def test_hashstore_grow_failure_degrades_to_sort_path(
+    golden_s2, monkeypatch
+):
+    """The automatic --no-hashstore: an injected grow failure degrades
+    the run to the sort-based visited path with identical counts.  The
+    slab floor is shrunk so the 50-state fixpoint actually crosses the
+    1/2-load growth line (at the default 1024-slot floor it never
+    grows and the fault site never fires)."""
+    from tla_raft_tpu.ops import hashstore
+
+    monkeypatch.setattr(hashstore, "MIN_CAP", 16)
+    faults.install("hashstore.grow:fail@1")
+    chk = JaxChecker(S2, chunk=64)
+    res = chk.run()
+    assert not chk.use_hashstore, "grow failure must disable the store"
+    assert res.distinct == golden_s2.distinct
+    assert res.level_sizes == golden_s2.level_sizes
+
+
+def test_exchange_fetch_transient_errors_are_retried(tmp_path, golden_s2):
+    """Transient deep-exchange fetch failures retry with backoff."""
+    if len(jax.devices()) < 2:
+        pytest.skip("not enough virtual devices")
+    faults.install("exchange.fetch:fail@2;exchange.fetch:fail@5")
+    res = ShardedChecker(
+        S2, make_mesh(2), cap_x=256, deep=True, seg_rows=8,
+        host_store_dir=str(tmp_path / "fps"),
+    ).run()
+    assert res.distinct == golden_s2.distinct
+    assert res.level_sizes == golden_s2.level_sizes
+
+
+def test_preempt_flag_exits_resumable(tmp_path, golden_s2):
+    """SIGTERM semantics, polled form: the flag makes the engine finish
+    the level, leave a durable log, and raise Preempted; the resume
+    completes with exact counts."""
+    ck = str(tmp_path / "ck")
+
+    def prog(s):
+        if s["level"] == 6:
+            resilience.request_preempt()
+
+    with pytest.raises(resilience.Preempted) as ei:
+        JaxChecker(S2, chunk=64, progress=prog).run(checkpoint_dir=ck)
+    assert ei.value.checkpoint_dir == ck
+    resilience.clear_preempt()
+    res = JaxChecker(S2, chunk=64).run(resume_from=ck, checkpoint_dir=ck)
+    assert res.distinct == golden_s2.distinct
+    assert res.level_sizes == golden_s2.level_sizes
+
+
+def test_partially_manifested_dir_adopts_verified_records(
+    tmp_path, golden_s2
+):
+    """A manifest that covers only part of the log (legacy upgrade, or
+    a torn MANIFEST.json followed by one manifested append) must ADOPT
+    the records that verify structurally — not destroy a valid log."""
+    ck = str(tmp_path / "ck")
+    JaxChecker(S2, chunk=64).run(max_depth=6, checkpoint_dir=ck)
+    mpath = os.path.join(ck, "MANIFEST.json")
+    doc = json.load(open(mpath))
+    for name in list(doc["artifacts"]):
+        if name != "delta_0006.npz":
+            del doc["artifacts"][name]
+    json.dump(doc, open(mpath, "w"))
+    res = JaxChecker(S2, chunk=64).run(resume_from=ck, checkpoint_dir=ck)
+    assert res.distinct == golden_s2.distinct
+    assert res.level_sizes == golden_s2.level_sizes
+    assert not os.path.isdir(os.path.join(ck, "quarantine"))
+    m = manifest.Manifest.load(ck)
+    assert m.verify("delta_0001.npz") == "ok"  # re-adopted + digested
+
+
+def test_run_fp_mismatch_refuses_foreign_directory(tmp_path):
+    """Two runs' logs must never interleave: resuming a directory
+    checkpointed under different spec constants is refused."""
+    ck = str(tmp_path / "ck")
+    JaxChecker(S2, chunk=64).run(max_depth=3, checkpoint_dir=ck)
+    other = RaftConfig(n_servers=2, n_vals=1, max_election=2,
+                       max_restart=1)
+    with pytest.raises(resilience.RunMismatch):
+        JaxChecker(other, chunk=64).run(resume_from=ck)
+
+
+# -- fault plan / manifest units ------------------------------------------
+
+def test_fault_plan_grammar():
+    p = faults.FaultPlan("delta.tmp:kill@3; hashstore.grow:fail")
+    assert ("delta.tmp", "kill", 3) in p.triggers
+    assert ("hashstore.grow", "fail", 1) in p.triggers
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.FaultPlan("nope.nope:kill")
+    with pytest.raises(ValueError, match="unknown fault action"):
+        faults.FaultPlan("delta.tmp:explode")
+    with pytest.raises(ValueError, match="expected site:action"):
+        faults.FaultPlan("delta.tmp")
+
+
+def test_manifest_commit_and_verify(tmp_path):
+    d = str(tmp_path)
+    resilience.commit_npz(
+        d, "delta_0001.npz", dict(a=np.arange(4)), kind="delta", depth=1,
+        run_fp="rfp:x",
+    )
+    m = manifest.Manifest.load(d)
+    assert m.exists and m.watermark == 1 and m.run_fp == "rfp:x"
+    assert m.verify("delta_0001.npz") == "ok"
+    _flip_byte(os.path.join(d, "delta_0001.npz"))
+    assert m.verify("delta_0001.npz") == "corrupt"
+    np.savez(os.path.join(d, "delta_0002.npz"), a=np.arange(2))
+    assert m.verify("delta_0002.npz") == "unmanifested"
+    with pytest.raises(resilience.RunMismatch):
+        resilience.commit_npz(
+            d, "delta_0003.npz", dict(a=np.arange(1)), kind="delta",
+            depth=3, run_fp="rfp:other",
+        )
+
+
+@pytest.mark.slow
+def test_crash_matrix_3121_prefix_single_device(tmp_path):
+    """The (3,1,2,1)-prefix row of the matrix: kill at a delta commit,
+    resume, and require the uninterrupted depth-5 prefix exactly."""
+    want = OracleChecker(S3121).run(max_depth=5)
+    ck = str(tmp_path / "ck")
+    # an in-process SIGKILL would take pytest down, so emulate what the
+    # subprocess matrix proves a delta.commit kill leaves behind:
+    # record 3 renamed but unmanifested, nothing deeper
+    JaxChecker(S3121, chunk=256).run(max_depth=5, checkpoint_dir=ck)
+    m = manifest.Manifest.load(ck)
+    m.forget("delta_0003.npz")
+    for name in ("delta_0004.npz", "delta_0005.npz"):
+        os.unlink(os.path.join(ck, name))
+        m.forget(name)
+    m.commit()
+    res = JaxChecker(S3121, chunk=256).run(
+        resume_from=ck, checkpoint_dir=ck, max_depth=5
+    )
+    assert res.depth == want.depth
+    assert res.distinct == want.distinct
+    assert list(res.level_sizes) == list(want.level_sizes)
+
+
+@pytest.mark.slow
+def test_crash_matrix_3121_prefix_mesh_deep(tmp_path):
+    """The (3,1,2,1)-prefix mesh-deep row: an unmanifested tail record
+    (the renamed-but-not-manifested crash window) is ADOPTED after
+    structural verification and the resume reproduces the prefix."""
+    if len(jax.devices()) < 4:
+        pytest.skip("not enough virtual devices")
+    want = OracleChecker(S3121).run(max_depth=5)
+    mesh = make_mesh(4)
+    ck = str(tmp_path / "ck")
+    ShardedChecker(
+        S3121, mesh, cap_x=1024, deep=True, seg_rows=32,
+        host_store_dir=str(tmp_path / "fps1"),
+    ).run(max_depth=5, checkpoint_dir=ck)
+    m = manifest.Manifest.load(ck)
+    m.forget("mdelta_0005.npz")
+    m.commit()
+    res = ShardedChecker(
+        S3121, mesh, cap_x=1024, deep=True, seg_rows=32,
+        host_store_dir=str(tmp_path / "fps2"),
+    ).run(resume_from=ck, checkpoint_dir=ck, max_depth=5)
+    assert res.depth == want.depth
+    assert res.distinct == want.distinct
+    assert list(res.level_sizes) == list(want.level_sizes)
+    # adopted, not destroyed: the record is back in the ledger
+    assert not os.path.isdir(os.path.join(ck, "quarantine"))
+    assert manifest.Manifest.load(ck).verify("mdelta_0005.npz") == "ok"

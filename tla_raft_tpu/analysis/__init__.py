@@ -31,5 +31,5 @@ from __future__ import annotations
 
 RULE_IDS = (
     "GL001", "GL002", "GL003", "GL004",
-    "GL005", "GL006", "GL007", "GL008",
+    "GL005", "GL006", "GL007", "GL008", "GL009",
 )

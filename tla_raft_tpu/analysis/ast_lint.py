@@ -43,6 +43,9 @@ RULES = {
     "GL007": "worker-device-dispatch: jax/jnp reference inside a "
              "function handed to a thread pool",
     "GL008": "unused-import: imported name never used",
+    "GL009": "raw-checkpoint-write: np.savez/os.replace outside "
+             "resilience/ — checkpoint artifacts must commit through "
+             "resilience.commit_npz",
 }
 
 # GL006 applies only to the hot level-loop modules (the ~140-site sync
@@ -514,6 +517,41 @@ class _Linter:
                     f"imported name `{name}` is never used",
                 )
 
+    def gl009_raw_checkpoint_write(self):
+        # the whole package except the subsystem that IS the writer:
+        # every np.savez / os.replace outside resilience/ is a
+        # checkpoint artifact bypassing the atomic-write + digest +
+        # manifest contract (the crash matrix only covers committed
+        # writers — an unrouted one is silently crash-unsafe)
+        rel = self.relpath
+        if not rel.startswith("tla_raft_tpu/") or rel.startswith(
+            "tla_raft_tpu/resilience/"
+        ):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None:
+                continue
+            last = d.split(".")[-1]
+            if last in ("savez", "savez_compressed"):
+                self.add(
+                    "GL009", node,
+                    f"`{d}(...)` writes a checkpoint artifact directly "
+                    "— route it through resilience.commit_npz (atomic "
+                    "rename + digest + MANIFEST.json), or waive with "
+                    "the reason it is not a checkpoint",
+                )
+            elif d == "os.replace":
+                self.add(
+                    "GL009", node,
+                    "`os.replace(...)` outside resilience/ — atomic "
+                    "checkpoint commits must route through "
+                    "resilience.commit_npz, or waive with the reason "
+                    "this rename is not a checkpoint commit",
+                )
+
     # -- driver ----------------------------------------------------------
 
     def run(self, select: set[str] | None = None) -> list[Finding]:
@@ -527,6 +565,7 @@ class _Linter:
             "GL006": self.gl006_host_sync_ledger,
             "GL007": self.gl007_worker_device_dispatch,
             "GL008": self.gl008_unused_import,
+            "GL009": self.gl009_raw_checkpoint_write,
         }
         for rule, fn in rules.items():
             if select is None or rule in select:

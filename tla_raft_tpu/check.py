@@ -104,6 +104,84 @@ def print_trace(cfg: RaftConfig, trace, out):
         print(format_state(cfg, st), file=out)
 
 
+def _report_preempted(e, out, logf) -> int:
+    """Preemption is a RESUMABLE outcome, not an error: say where the
+    durable state sits and exit 75 (EX_TEMPFAIL — the supervisor and
+    any fleet scheduler relaunch on it)."""
+    print(f"Preempted: {e}.", file=out)
+    if e.checkpoint_dir:
+        print(f"Resume with --recover {e.checkpoint_dir}", file=out)
+    if logf:
+        logf.close()
+    return 75
+
+
+def _has_checkpoints(ckdir: str) -> bool:
+    import glob
+
+    return bool(
+        glob.glob(os.path.join(ckdir, "delta_*.npz"))
+        or glob.glob(os.path.join(ckdir, "mdelta_*.npz"))
+        or os.path.exists(os.path.join(ckdir, "base.npz"))
+    )
+
+
+def _supervise(args, raw_argv) -> int:
+    """Supervisor mode: run the check as a child process, relaunching a
+    crashed/preempted child from its own checkpoint directory up to N
+    times (TLC deployments wrap the jar in exactly this kind of babysit
+    loop; ``--supervise`` builds it in).  Terminal exits — clean sweep
+    (0), model violation (1), usage error (2), sanitizer findings (3)
+    — are returned as-is; anything else (SIGKILL, OOM, preemption exit
+    75) relaunches with ``--recover`` pointing at the checkpoint dir,
+    where the self-healing resume quarantines whatever the crash tore."""
+    import subprocess
+
+    if not args.checkpoint_dir:
+        print(
+            "--supervise requires --checkpoint-dir (the relaunch "
+            "resumes from it)",
+            file=sys.stderr,
+        )
+        return 2
+    child_args = []
+    skip = False
+    for a in raw_argv:
+        if skip:
+            skip = False
+            continue
+        if a == "--supervise":
+            skip = True
+            continue
+        if a.startswith("--supervise="):
+            continue
+        child_args.append(a)
+    attempts = 0
+    while True:
+        cmd = [sys.executable, "-m", "tla_raft_tpu.check", *child_args]
+        if (
+            "--recover" not in child_args
+            and _has_checkpoints(args.checkpoint_dir)
+        ):
+            cmd += ["--recover", args.checkpoint_dir]
+        rc = subprocess.call(cmd)
+        if rc in (0, 1, 2, 3):
+            return rc
+        attempts += 1
+        if attempts > args.supervise:
+            print(
+                f"supervise: giving up after {attempts - 1} "
+                f"relaunch(es) (last exit {rc})",
+                file=sys.stderr,
+            )
+            return rc
+        print(
+            f"supervise: child exited {rc}; relaunch "
+            f"{attempts}/{args.supervise} from {args.checkpoint_dir}",
+            file=sys.stderr,
+        )
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tla_raft_tpu.check")
     p.add_argument("--config", default="/root/reference/Raft.cfg",
@@ -145,7 +223,22 @@ def main(argv=None) -> int:
                         "cannot skip levels)")
     p.add_argument("--recover", default=None,
                    help="resume from a checkpoint: the --checkpoint-dir "
-                        "directory (delta log) or a monolith .npz")
+                        "directory (delta log) or a monolith .npz; "
+                        "corrupt/torn/unmanifested records are "
+                        "quarantined and the run resumes from the last "
+                        "good contiguous prefix (docs/ROBUSTNESS.md)")
+    p.add_argument("--fault", action="append", default=None,
+                   metavar="SITE:ACTION[@N]",
+                   help="deterministic fault injection (repeatable): "
+                        "kill/torn/flip/fail at a named site's Nth hit, "
+                        "e.g. delta.commit:kill@3 (docs/ROBUSTNESS.md; "
+                        "env TLA_RAFT_FAULT takes the same grammar)")
+    p.add_argument("--supervise", type=int, default=0, metavar="N",
+                   help="supervisor mode: run the check as a child "
+                        "process and relaunch it from its own "
+                        "--checkpoint-dir up to N times after a crash "
+                        "or preemption (model verdicts and usage "
+                        "errors are terminal, never relaunched)")
     p.add_argument("--mesh", type=int, default=0,
                    help="run distributed over an N-device mesh (0 = single device)")
     p.add_argument("--exchange", choices=("all_to_all", "all_gather"),
@@ -178,6 +271,13 @@ def main(argv=None) -> int:
                    help="print per-action fired-transition counts (TLC -coverage)")
     p.add_argument("--json", action="store_true", help="emit a final JSON summary line")
     args = p.parse_args(argv)
+
+    if args.supervise:
+        return _supervise(args, argv if argv is not None else sys.argv[1:])
+    if args.fault:
+        from .resilience import faults as _faults
+
+        _faults.install(";".join(args.fault))
 
     cfg = load_raft_config(args.config)
     overrides = {}
@@ -236,9 +336,15 @@ def main(argv=None) -> int:
 
         res = OracleChecker(cfg).run(max_depth=args.max_depth)
     else:
+        from . import resilience
         from .platform import setup_jax
 
         jax = setup_jax()
+        # SIGTERM/SIGINT request a cooperative preemption: the engine
+        # finishes the in-flight level, flushes its checkpoints, and
+        # raises Preempted -> exit 75 (resumable); a second signal
+        # kills immediately.  CLI-only — libraries poll the flag.
+        resilience.install_signal_handlers()
 
         from .engine import JaxChecker
 
@@ -302,13 +408,16 @@ def main(argv=None) -> int:
                 sieve=not args.no_sieve, compress=not args.no_compress,
                 use_hashstore=not args.no_hashstore,
             )
-            with sanctx:
-                res = chk.run(
-                    max_depth=args.max_depth,
-                    checkpoint_dir=args.checkpoint_dir,
-                    checkpoint_every=args.checkpoint_every,
-                    resume_from=args.recover,
-                )
+            try:
+                with sanctx:
+                    res = chk.run(
+                        max_depth=args.max_depth,
+                        checkpoint_dir=args.checkpoint_dir,
+                        checkpoint_every=args.checkpoint_every,
+                        resume_from=args.recover,
+                    )
+            except resilience.Preempted as e:
+                return _report_preempted(e, out, logf)
             if args.mesh_deep and chk.meter.levels:
                 # run-summary exchange ledger: the sieve+compress bytes
                 # vs what the uncompressed exchange would have moved
@@ -330,17 +439,20 @@ def main(argv=None) -> int:
                         file=out,
                     )
         else:
-            with sanctx:
-                res = JaxChecker(
-                    cfg, chunk=args.chunk, progress=progress,
-                    host_store=host_store, canon=args.canon,
-                    use_hashstore=not args.no_hashstore,
-                ).run(
-                    max_depth=args.max_depth,
-                    checkpoint_dir=args.checkpoint_dir,
-                    checkpoint_every=args.checkpoint_every,
-                    resume_from=args.recover,
-                )
+            try:
+                with sanctx:
+                    res = JaxChecker(
+                        cfg, chunk=args.chunk, progress=progress,
+                        host_store=host_store, canon=args.canon,
+                        use_hashstore=not args.no_hashstore,
+                    ).run(
+                        max_depth=args.max_depth,
+                        checkpoint_dir=args.checkpoint_dir,
+                        checkpoint_every=args.checkpoint_every,
+                        resume_from=args.recover,
+                    )
+            except resilience.Preempted as e:
+                return _report_preempted(e, out, logf)
 
     dt = time.monotonic() - t0
     print(file=out)
@@ -380,6 +492,9 @@ def main(argv=None) -> int:
                     distinct=res.distinct,
                     generated=res.generated,
                     depth=res.depth,
+                    # the crash-matrix tests diff these against an
+                    # uninterrupted run's, level by level
+                    level_sizes=list(res.level_sizes),
                     seconds=round(dt, 3),
                 )
             ),

@@ -65,6 +65,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import resilience
 from ..analysis import sanitize as graft_sanitize
 from ..config import RaftConfig
 from ..models.raft import RaftState, init_batch, to_oracle
@@ -580,6 +581,14 @@ class JaxChecker:
         self.orbit = bool(int(env_orb)) if env_orb else False
         if self.orbit and canon != "late":
             raise ValueError("TLA_RAFT_ORBIT requires canon='late'")
+        # semantic run fingerprint for the checkpoint manifests: spec
+        # constants only — NOT tunables like chunk (a resume may retune
+        # those freely), NOT the store tier (the three tiers share one
+        # delta-log format), and NOT the fingerprint definition: orbit
+        # mixing is guarded one layer down by the per-record fp_def
+        # check, whose specific "fingerprint-definition mismatch" error
+        # tells the operator which knob to flip.
+        self._run_fp = resilience.run_config_fingerprint(cfg, log="delta")
         self._jit_expand_programs()
 
     def _jit_expand_programs(self):
@@ -973,25 +982,28 @@ class JaxChecker:
 
     def _save_delta(self, ckdir, depth, pidx_np, slot_np, fps_np,
                     level_mult, n_new):
-        os.makedirs(ckdir, exist_ok=True)
-        tmp = os.path.join(ckdir, f".tmp_delta_{depth:04d}.npz")
         # slot ids must round-trip the log exactly; K grows with the
         # S/T/L/V bounds (3,696 at S=7), so widen past the u16 range
         # rather than silently wrapping (the loader reads either width)
         slot_dt = np.uint16 if self.K <= 0xFFFF else np.uint32
-        np.savez(
-            tmp,
-            pidx=pidx_np.astype(np.uint32),
-            slot=slot_np.astype(slot_dt),
-            fps=fps_np.astype(np.uint64),
-            mult=level_mult.astype(np.int64),
-            # meta[2] (fp definition: 0 = min-over-P fold, 1 = orbit
-            # canonical-relabel) guards resume: the two definitions
-            # produce different fingerprint VALUES and must never mix in
-            # one visited store.  Old two-element logs read as 0.
-            meta=np.asarray([depth, n_new, int(self.orbit)], np.int64),
+        resilience.commit_npz(
+            ckdir,
+            f"delta_{depth:04d}.npz",
+            dict(
+                pidx=pidx_np.astype(np.uint32),
+                slot=slot_np.astype(slot_dt),
+                fps=fps_np.astype(np.uint64),
+                mult=level_mult.astype(np.int64),
+                # meta[2] (fp definition: 0 = min-over-P fold, 1 = orbit
+                # canonical-relabel) guards resume: the two definitions
+                # produce different fingerprint VALUES and must never mix
+                # in one visited store.  Old two-element logs read as 0.
+                meta=np.asarray([depth, n_new, int(self.orbit)], np.int64),
+            ),
+            kind="delta",
+            depth=depth,
+            run_fp=self._run_fp,
         )
-        os.replace(tmp, os.path.join(ckdir, f"delta_{depth:04d}.npz"))
 
     def _materialize_payload_slices(self, frontier, new_payload, n_new):
         """Run _mat_slice over every survivor slice; returns the parts.
@@ -1400,9 +1412,59 @@ class JaxChecker:
         itself resumed from a monolith starts appending deltas)."""
         import glob
 
-        files = sorted(glob.glob(os.path.join(ckdir, "delta_*.npz")))
+        # -- self-healing pass (resilience/recover.py): sweep orphaned
+        # .tmp_* files, verify every record against the directory
+        # manifest, quarantine corrupt/torn/unmanifested records and
+        # truncate the chain to the last good contiguous prefix.  The
+        # replay below then consumes only verified records; its gap
+        # check stays as the backstop for the interior-hole case.
         base_path = os.path.join(ckdir, "base.npz")
-        if not files and not os.path.exists(base_path):
+        man = resilience.Manifest.load(ckdir)
+        man.bind_run(self._run_fp)
+        base_depth = 0
+        if os.path.exists(base_path):
+            st_base = man.verify("base.npz") if man.exists else "ok"
+            if st_base == "unmanifested":
+                # renamed/copied in before the manifest commit landed:
+                # the meta read below is the structural probe; adopt so
+                # the chain it anchors survives the next heal too
+                resilience.adopt_file(
+                    ckdir, "base.npz", kind="base", run_fp=self._run_fp
+                )
+                st_base = "ok"
+            ok_base = st_base == "ok"
+            if ok_base:
+                try:
+                    base_depth = int(np.load(base_path)["meta"][3])
+                except (OSError, ValueError, KeyError, EOFError,
+                        zipfile.BadZipFile):
+                    ok_base = False
+            if not ok_base:
+                # the whole delta chain hangs off the base snapshot:
+                # with it gone the deltas are orphans — quarantine
+                # everything and restart from Init (the worst-case but
+                # still hands-free recovery)
+                resilience.quarantine(
+                    ckdir, "base.npz", "corrupt base snapshot", man
+                )
+                for f in sorted(
+                    glob.glob(os.path.join(ckdir, "delta_*.npz"))
+                ):
+                    resilience.quarantine(
+                        ckdir, os.path.basename(f),
+                        "orphaned by quarantined base", man,
+                    )
+                if man.exists:
+                    man.commit()
+        files = resilience.heal_log(
+            ckdir, "delta", run_fp=self._run_fp, slabs=("hslab.npz",),
+            start_depth=base_depth + 1,
+        )
+        if (
+            not files and not os.path.exists(base_path) and not man.exists
+        ):
+            # a directory that was never one of ours (no manifest, no
+            # records) is a caller error, not a healable crash
             raise ValueError(f"no delta_*.npz checkpoints under {ckdir}")
         if self.host_store is not None:
             # rebuild the external store from the log as the replay walks
@@ -1585,7 +1647,6 @@ class JaxChecker:
         for i, (p, s) in enumerate(trace_levels):
             arrs[f"trace_p{i}"] = p
             arrs[f"trace_s{i}"] = s
-        tmp = f"{path}.tmp.npz"
         payload = dict(
             visited=np.asarray(visited),
             mult_per_slot=mult_per_slot,
@@ -1598,9 +1659,40 @@ class JaxChecker:
         # zlib on multi-GB frontiers costs ~a minute of host time per
         # level; past 256 MB the disk is cheaper than the CPU
         total = sum(a.nbytes for a in payload.values())
-        save = np.savez_compressed if total < (256 << 20) else np.savez
-        save(tmp, **payload)
-        os.replace(tmp, path)
+        resilience.commit_npz(
+            os.path.dirname(os.path.abspath(path)),
+            os.path.basename(path),
+            payload,
+            kind="monolith",
+            depth=depth,
+            run_fp=self._run_fp,
+            compressed=total < (256 << 20),
+        )
+
+    def _degrade_hashstore(self, why) -> jnp.ndarray:
+        """Hash-store grow failed (device OOM or an injected
+        ``hashstore.grow`` fault): fall back to the sort-based visited
+        path MID-RUN — the automatic form of the ``--no-hashstore``
+        lever — instead of dying.  The slab's live slots hold exactly
+        the visited set, so one fetch + sort rebuilds the sorted store
+        losslessly and the run continues with identical counts."""
+        print(
+            f"[resilience] hash-store grow failed ({why}); degrading to "
+            "the sort-based visited path (--no-hashstore equivalent) "
+            "for the rest of the run",
+            file=sys.stderr,
+        )
+        # graftlint: waive[GL006] — one-time degradation fetch
+        vb = np.asarray(jax.device_get(self.hstore.slab))
+        vb = np.sort(vb[vb != SENT])
+        pad = _cap4(len(vb) + 1) - len(vb)
+        visited = jnp.concatenate(
+            [jnp.asarray(vb), jnp.full((pad,), SENT, U64)]
+        )
+        self.use_hashstore = False
+        self.hstore = None
+        self._hs_pending = None
+        return visited
 
     def _check_fp_def(self, fp_def: int, path: str) -> None:
         """Refuse to mix fingerprint definitions in one visited store."""
@@ -2017,21 +2109,25 @@ class JaxChecker:
                 False, mult_np)
 
     def _save_partial(self, ckdir, level, gi, hv, hf, hp, mult, n_f):
-        os.makedirs(ckdir, exist_ok=True)
-        name = f"partial_{level:04d}_{gi:05d}.npz"
-        tmp = os.path.join(ckdir, f".tmp_partial_{level:04d}_{gi:05d}.npz")
-        np.savez(
-            tmp, hv=hv, hf=hf, hp=hp, mult=mult,
-            # meta[7]: fingerprint definition (0 = min-over-P, 1 = orbit)
-            # — a partial's hv/hf are raw fingerprints and must never be
-            # replayed into a run using the other definition
-            meta=np.asarray(
-                [level, gi, self.chunk, self.cap_x, self.G, self.K, n_f,
-                 int(self.orbit)],
-                np.int64,
+        resilience.commit_npz(
+            ckdir,
+            f"partial_{level:04d}_{gi:05d}.npz",
+            dict(
+                hv=hv, hf=hf, hp=hp, mult=mult,
+                # meta[7]: fingerprint definition (0 = min-over-P,
+                # 1 = orbit) — a partial's hv/hf are raw fingerprints and
+                # must never be replayed into a run using the other
+                # definition
+                meta=np.asarray(
+                    [level, gi, self.chunk, self.cap_x, self.G, self.K,
+                     n_f, int(self.orbit)],
+                    np.int64,
+                ),
             ),
+            kind="partial",
+            depth=level,
+            run_fp=self._run_fp,
         )
-        os.replace(tmp, os.path.join(ckdir, name))
 
     def _load_partials(self, ckdir, level, n_f):
         """Completed-group partials for this level; stale ones are wiped.
@@ -2043,6 +2139,7 @@ class JaxChecker:
         import glob
 
         out = {}
+        stale = []
         for f in sorted(glob.glob(os.path.join(ckdir, "partial_*.npz"))):
             try:
                 z = np.load(f)
@@ -2058,7 +2155,7 @@ class JaxChecker:
                 got = (meta[0], meta[1], meta[2], meta[4], meta[5], meta[6],
                        fp_def)
                 if level is None or got != want:
-                    os.unlink(f)
+                    stale.append(os.path.basename(f))
                     continue
                 rec = dict(
                     hv=z["hv"], hf=z["hf"], hp=z["hp"],
@@ -2068,16 +2165,21 @@ class JaxChecker:
                     zipfile.BadZipFile):
                 # crash-truncated partial: the zip layer raises any of
                 # these depending on where the write stopped
-                os.unlink(f)
+                stale.append(os.path.basename(f))
                 continue
             out[meta[1]] = rec
+        if stale:
+            resilience.discard_artifacts(ckdir, stale)
         return out
 
     def _wipe_partials(self, ckdir):
         import glob
 
-        for f in glob.glob(os.path.join(ckdir, "partial_*.npz")):
-            os.unlink(f)
+        resilience.discard_artifacts(
+            ckdir,
+            [os.path.basename(f)
+             for f in glob.glob(os.path.join(ckdir, "partial_*.npz"))],
+        )
 
     def run(
         self,
@@ -2093,6 +2195,11 @@ class JaxChecker:
         if checkpoint_dir and checkpoint_every:
             import glob as _glob
 
+            if resume_from is None and os.path.isdir(checkpoint_dir):
+                # a killed earlier writer must not leak .tmp_* files
+                # into a fresh run's directory (they waste disk and
+                # shadow names; resume paths sweep via heal_log)
+                resilience.sweep_tmp(checkpoint_dir)
             stale = _glob.glob(os.path.join(checkpoint_dir, "delta_*.npz"))
             has_base = os.path.exists(os.path.join(checkpoint_dir, "base.npz"))
             if resume_from is None and (stale or has_base):
@@ -2151,6 +2258,10 @@ class JaxChecker:
                 os.makedirs(checkpoint_dir, exist_ok=True)
                 shutil.copyfile(
                     resume_from, os.path.join(checkpoint_dir, "base.npz")
+                )
+                resilience.adopt_file(
+                    checkpoint_dir, "base.npz", kind="base",
+                    run_fp=self._run_fp,
                 )
         if resume_from is not None:
             if os.path.isdir(resume_from):
@@ -2249,6 +2360,14 @@ class JaxChecker:
             ]
 
         while n_f > 0:
+            resilience.fault_fire("level.start")
+            if resilience.preempt_requested():
+                # every completed level's delta record is already
+                # durable (written synchronously at level end), so
+                # there is nothing left to flush — exit resumable
+                raise resilience.Preempted(
+                    checkpoint_dir if checkpoint_every else None, depth
+                )
             if max_depth is not None and depth >= max_depth:
                 break
             if self.presize and len(level_sizes) > PRESIZE_MIN_LEVELS:
@@ -2260,7 +2379,12 @@ class JaxChecker:
                     # pow2 magnitude instead of overflow-redoing levels
                     ent = getattr(self, "_presize_entries", 0)
                     if ent:
-                        self.hstore.reserve(int(ent * 1.1))
+                        try:
+                            self.hstore.reserve(int(ent * 1.1))
+                        except Exception as e:  # graftlint: waive[GL003]
+                            # a failed presize reserve degrades like any
+                            # other grow failure (reserve() only grows)
+                            visited = self._degrade_hashstore(e)
                 elif (self.host_store is None
                         and self._presize_vcap > visited.shape[0]):
                     # SENT-pad the sorted store up front so its shape is
@@ -2292,7 +2416,12 @@ class JaxChecker:
                     # redo against the ORIGINAL slab (the pending update
                     # is discarded — the kernels are functional)
                     self._hs_pending = None
-                    self.hstore.grow()
+                    try:
+                        self.hstore.grow()
+                    except Exception as e:  # graftlint: waive[GL003]
+                        # any grow failure (device OOM, injected fault)
+                        # degrades to the sort path — never mid-run death
+                        visited = self._degrade_hashstore(e)
                 if overflow:
                     # half-step growth ({2^k, 3*2^(k-1)}): a doubled cap_x
                     # inflates every downstream lane count (group filter,
@@ -2384,7 +2513,12 @@ class JaxChecker:
                 self.hstore.adopt(self._hs_pending, n_new)
                 self._hs_pending = None
                 if self.hstore.need_grow(extra=2 * n_new):
-                    self.hstore.grow()
+                    try:
+                        self.hstore.grow()
+                    except Exception as e:  # graftlint: waive[GL003]
+                        # grow failure degrades to the sort path (the
+                        # adopted slab holds the full visited set)
+                        visited = self._degrade_hashstore(e)
             elif self.host_store is None:
                 # merge, then trim the store to a pow4 capacity >= distinct;
                 # new_fps is survivor-compacted, so slicing keeps every
@@ -2502,7 +2636,7 @@ class JaxChecker:
                         and depth % dump_every == 0):
                     self.hstore.dump(
                         os.path.join(checkpoint_dir, "hslab.npz"),
-                        depth, int(self.orbit),
+                        depth, int(self.orbit), run_fp=self._run_fp,
                     )
                 if self.host_store is not None:
                     # the level's per-group partials are superseded by its

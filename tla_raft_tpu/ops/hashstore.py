@@ -457,7 +457,14 @@ class DeviceHashStore:
     def grow(self, min_cap: int | None = None):
         """Rehash into a bigger slab (the old slab's live entries are
         unique, so one insert_only pass re-places them; on the rare
-        probe overflow at the new size, double again)."""
+        probe overflow at the new size, double again).
+
+        May raise (allocation failure on a full device, or an injected
+        ``hashstore.grow`` fault): the engines catch and DEGRADE to the
+        sort-based visited path instead of dying mid-run."""
+        from ..resilience import faults
+
+        faults.fire("hashstore.grow")
         want = max(self.cap * 2, min_cap or 0)
         want = 1 << (want - 1).bit_length()
         while True:
@@ -477,20 +484,28 @@ class DeviceHashStore:
 
     # -- slab checkpoint (dump + load, versioned) ----------------------
 
-    def dump(self, path: str, depth: int, fp_def: int = 0):
-        """Atomic slab snapshot next to the engine's delta records."""
+    def dump(self, path: str, depth: int, fp_def: int = 0,
+             run_fp: str | None = None):
+        """Atomic slab snapshot next to the engine's delta records
+        (digested + manifested via the shared atomic writer)."""
         import os
 
-        tmp = path + ".tmp.npz"
-        np.savez(
-            tmp,
-            slab=np.asarray(jax.device_get(self.slab)),
-            meta=np.asarray(
-                [SLAB_VERSION, depth, self.count, self.cap, fp_def],
-                np.int64,
+        from ..resilience import commit_npz
+
+        commit_npz(
+            os.path.dirname(path) or ".",
+            os.path.basename(path),
+            dict(
+                slab=np.asarray(jax.device_get(self.slab)),
+                meta=np.asarray(
+                    [SLAB_VERSION, depth, self.count, self.cap, fp_def],
+                    np.int64,
+                ),
             ),
+            kind="hslab",
+            depth=depth,
+            run_fp=run_fp,
         )
-        os.replace(tmp, path)
 
     @classmethod
     def load(cls, path: str, depth: int, count: int, fp_def: int = 0):
@@ -499,6 +514,8 @@ class DeviceHashStore:
         replayed fingerprints — the dump is an optimization, never the
         source of truth)."""
         import os
+
+        import zipfile
 
         if not os.path.exists(path):
             return None
@@ -515,5 +532,9 @@ class DeviceHashStore:
             st.count = cnt
             st.slab = jnp.asarray(z["slab"])
             return st
-        except (OSError, ValueError, KeyError):
+        except (OSError, ValueError, KeyError, EOFError,
+                zipfile.BadZipFile):
+            # a torn/corrupt snapshot reads as "no snapshot": the
+            # caller rebuilds from the replayed log (the dump is an
+            # optimization, never the source of truth)
             return None

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import resilience
 from ..analysis import sanitize as graft_sanitize
 from ..config import RaftConfig
 from ..engine.bfs import _compact_payloads
@@ -342,6 +343,13 @@ class ShardedChecker:
         self.reactive_grows = 0
         self.progress = progress
         self.inv_fns = [(n, resolve_invariant_kernel(n)) for n in cfg.invariants]
+        # semantic run fingerprint for the checkpoint manifests: spec
+        # constants + everything the mdelta record meta already pins
+        # (D, exchange, canon) — NOT tunables (cap_x, seg_rows), which
+        # a resume may retune freely
+        self._run_fp = resilience.run_config_fingerprint(
+            cfg, log="mdelta", D=self.D, exchange=exchange, canon=canon
+        )
 
     # -- the per-device level body ----------------------------------------
 
@@ -1459,13 +1467,26 @@ class ShardedChecker:
         arr = np.asarray(
             jax.device_get(self._sieve_cache)
         ).reshape(self.D, self.scap)
-        if self.use_hashstore:
-            # hash slabs rehash on growth (slot homes move with the
-            # capacity mask — padding would orphan every cached entry)
-            new = hashstore.rebuild_np(arr, new_scap)
-        else:
-            pad = np.full((self.D, new_scap - self.scap), SENT)
-            new = np.concatenate([arr, pad], axis=1)
+        try:
+            resilience.fault_fire("hashstore.grow")
+            if self.use_hashstore:
+                # hash slabs rehash on growth (slot homes move with the
+                # capacity mask — padding would orphan every cached
+                # entry)
+                new = hashstore.rebuild_np(arr, new_scap)
+            else:
+                pad = np.full((self.D, new_scap - self.scap), SENT)
+                new = np.concatenate([arr, pad], axis=1)
+        except Exception as e:  # graftlint: waive[GL003]
+            # the sieve is a pure optimization cache: a failed growth
+            # (host OOM, injected fault) costs effectiveness, never
+            # correctness — keep the current capacity and move on
+            print(
+                f"[resilience] sieve grow to {new_scap} failed ({e}); "
+                "keeping the current sieve capacity",
+                file=sys.stderr,
+            )
+            return
         self.scap = new_scap
         self._sieve_cache = jax.device_put(
             jnp.asarray(new).reshape(-1),
@@ -1611,19 +1632,30 @@ class ShardedChecker:
         qb = min(packed_quantum(max(int(totals.max()), 1)), cap8)
         qn = min(packed_quantum(max((max_nu + 1) // 2, 1)), capnib)
         packed_ok = self.compress and (qb + qn) < qf * 8
-        if packed_ok:
-            st_all = np.asarray(jax.device_get(
-                self._deep_prefix(cap8, qb)(fin.stream)
-            )).reshape(D, qb)
-            nb_all = np.asarray(jax.device_get(
-                self._deep_prefix(capnib, qn)(fin.nib)
-            )).reshape(D, qn)
-            fetch_bytes = D * (qb + qn)
-        else:
-            uq_all = np.asarray(jax.device_get(
+
+        def fetch_prefixes():
+            """The quantized-prefix host fetch, as one IDEMPOTENT unit:
+            re-fetching an already-computed device array has no side
+            effects, so transient link failures retry with backoff
+            (resilience.with_retry) instead of killing a multi-hour
+            sweep.  The fault site makes the retry path testable."""
+            resilience.fault_fire("exchange.fetch")
+            if packed_ok:
+                st = np.asarray(jax.device_get(
+                    self._deep_prefix(cap8, qb)(fin.stream)
+                )).reshape(D, qb)
+                nb = np.asarray(jax.device_get(
+                    self._deep_prefix(capnib, qn)(fin.nib)
+                )).reshape(D, qn)
+                return st, nb, None, D * (qb + qn)
+            uqh = np.asarray(jax.device_get(
                 self._deep_prefix(cap_acc, qf)(uq)
             )).reshape(D, qf)
-            fetch_bytes = D * qf * 8
+            return None, None, uqh, D * qf * 8
+
+        st_all, nb_all, uq_all, fetch_bytes = resilience.with_retry(
+            fetch_prefixes, "deep exchange prefix fetch"
+        )
         inserted = np.zeros(D, np.int64)
 
         def insert_one(o):
@@ -1786,6 +1818,10 @@ class ShardedChecker:
         if checkpoint_dir and checkpoint_every:
             import glob as _glob
 
+            if resume_from is None and os.path.isdir(checkpoint_dir):
+                # a killed earlier writer must not leak .tmp_* files
+                # into a fresh run's directory
+                resilience.sweep_tmp(checkpoint_dir)
             has_log = _glob.glob(
                 os.path.join(checkpoint_dir, "mdelta_*.npz")
             )
@@ -1804,12 +1840,19 @@ class ShardedChecker:
         self.peak_dev_rows = 0
         ck_fut = None
 
+        ck = None
         if resume_from is not None:
             if not os.path.isdir(resume_from):
                 raise ValueError(
                     "deep mode resumes from an mdelta directory only"
                 )
             ck = self._resume_from_mdeltas(resume_from, shard, repl)
+            if ck is None:
+                # healing left nothing replayable: restart from Init
+                # with clean stores (they may hold pre-crash inserts)
+                for s in self.host_stores:
+                    s.clear()
+        if ck is not None:
             fr = ck["frontier"]
             rows = fr.voted_for.shape[0] // D
             R = max(1, -(-rows // seg))
@@ -1890,6 +1933,15 @@ class ShardedChecker:
                 ck_fut = None
 
         while True:
+            resilience.fault_fire("level.start")
+            if resilience.preempt_requested():
+                # the deferred tail writer may still hold the last
+                # level's record — join it so the log is complete, then
+                # exit resumable
+                join_ck()
+                raise resilience.Preempted(
+                    checkpoint_dir if checkpoint_every else None, depth
+                )
             if max_depth is not None and depth >= max_depth:
                 break
             if presize and len(level_sizes) > MIN_LEVELS:
@@ -2118,17 +2170,21 @@ class ShardedChecker:
         os.makedirs(ckdir, exist_ok=True)
         if sieve_np is not None:
             rows = sieve_np.shape[0] // self.D
-            tmp = os.path.join(ckdir, ".tmp_sieve_slab.npz")
-            np.savez(
-                tmp,
-                slab=sieve_np,
-                meta=np.asarray(
-                    [hashstore.SLAB_VERSION, depth, self.D, rows,
-                     int(self.use_hashstore)],
-                    np.int64,
+            resilience.commit_npz(
+                ckdir,
+                "sieve_slab.npz",
+                dict(
+                    slab=sieve_np,
+                    meta=np.asarray(
+                        [hashstore.SLAB_VERSION, depth, self.D, rows,
+                         int(self.use_hashstore)],
+                        np.int64,
+                    ),
                 ),
+                kind="sieve",
+                depth=depth,
+                run_fp=self._run_fp,
             )
-            os.replace(tmp, os.path.join(ckdir, "sieve_slab.npz"))
         gpidx = np.asarray(out.gpidx).astype(np.int64)
         slots = np.asarray(out.slots).astype(np.int64)
         n_local = np.asarray(out.n_new_local).astype(np.int64).reshape(-1)
@@ -2147,21 +2203,25 @@ class ShardedChecker:
             if valid.sum() == 0 or gpidx[valid].max() <= 0xFFFFFFFF
             else np.uint64
         )
-        tmp = os.path.join(ckdir, f".tmp_mdelta_{depth:04d}.npz")
-        np.savez(
-            tmp,
-            pidx=gpidx[valid].astype(pidx_dt),
-            slot=slots[valid].astype(slot_dt),
-            n_local=n_local,
-            mult=np.asarray(out.mult_slots, np.int64),
-            meta=np.asarray(
-                [depth, int(valid.sum()), self.D, cap_f, cap_c,
-                 1 if self.exchange == "all_to_all" else 0,
-                 1 if self.canon == "late" else 0],
-                np.int64,
+        resilience.commit_npz(
+            ckdir,
+            f"mdelta_{depth:04d}.npz",
+            dict(
+                pidx=gpidx[valid].astype(pidx_dt),
+                slot=slots[valid].astype(slot_dt),
+                n_local=n_local,
+                mult=np.asarray(out.mult_slots, np.int64),
+                meta=np.asarray(
+                    [depth, int(valid.sum()), self.D, cap_f, cap_c,
+                     1 if self.exchange == "all_to_all" else 0,
+                     1 if self.canon == "late" else 0],
+                    np.int64,
+                ),
             ),
+            kind="mdelta",
+            depth=depth,
+            run_fp=self._run_fp,
         )
-        os.replace(tmp, os.path.join(ckdir, f"mdelta_{depth:04d}.npz"))
 
     def _resume_from_mdeltas(self, ckdir, shard, repl):
         """Rebuild the mesh run state by replaying the delta log from Init.
@@ -2172,10 +2232,22 @@ class ShardedChecker:
         the rebuilt store holds exactly what an uninterrupted run's would
         (fp %% D shards for all_to_all, a sorted replicated array for
         all_gather)."""
-        import glob
-
-        files = sorted(glob.glob(os.path.join(ckdir, "mdelta_*.npz")))
+        # -- self-healing pass: sweep orphaned tmp files, digest-verify
+        # every record, quarantine corrupt/torn/unmanifested ones and
+        # truncate to the last good contiguous prefix (a TAIL gap is a
+        # healed crash; only an interior hole — which the ordered
+        # writer cannot produce — stays fatal).  A bad sieve slab is
+        # quarantined here and the resume silently starts with an
+        # empty sieve (it is a pure optimization cache).
+        files = resilience.heal_log(
+            ckdir, "mdelta", run_fp=self._run_fp,
+            slabs=("sieve_slab.npz",),
+        )
         if not files:
+            if resilience.Manifest.load(ckdir).exists:
+                # everything was quarantined: restart from Init (the
+                # worst-case but still hands-free recovery)
+                return None
             raise ValueError(f"no mdelta_*.npz checkpoints under {ckdir}")
         cfg, K, D = self.cfg, self.K, self.D
         frontier = init_batch(cfg, D)  # layout [D, cap_f=1]
@@ -2328,16 +2400,20 @@ class ShardedChecker:
                 or gpidx_n[validn].max() <= 0xFFFFFFFF
                 else np.uint64
             )
-            tmp = files[-1] + ".tmp.npz"  # np.savez appends .npz itself
-            np.savez(
-                tmp,
-                pidx=gpidx_n[validn].astype(pidx_dt),
-                slot=slots_n[validn].astype(slot_dt),
-                n_local=n_local,
-                mult=z_last["mult"],
-                meta=z_last["meta"],
+            resilience.commit_npz(
+                ckdir,
+                os.path.basename(files[-1]),
+                dict(
+                    pidx=gpidx_n[validn].astype(pidx_dt),
+                    slot=slots_n[validn].astype(slot_dt),
+                    n_local=n_local,
+                    mult=z_last["mult"],
+                    meta=z_last["meta"],
+                ),
+                kind="mdelta",
+                depth=depth,
+                run_fp=self._run_fp,
             )
-            os.replace(tmp, files[-1])
         if self.host_stores is not None:
             # the replay rebuilds the EXTERNAL stores: clear first (they
             # may hold pre-crash inserts, including a partially-completed
@@ -2485,6 +2561,9 @@ class ShardedChecker:
         if checkpoint_dir and checkpoint_every:
             import glob as _glob
 
+            if resume_from is None and os.path.isdir(checkpoint_dir):
+                # sweep a killed earlier writer's orphaned tmp files
+                resilience.sweep_tmp(checkpoint_dir)
             has_log = _glob.glob(os.path.join(checkpoint_dir, "mdelta_*.npz"))
             if resume_from is None and has_log:
                 raise ValueError(
@@ -2504,11 +2583,18 @@ class ShardedChecker:
                     f"level {1}+gap); resume from the delta directory, or "
                     "drop --checkpoint-dir for this run"
                 )
+        ck = None
         if resume_from is not None:
             if os.path.isdir(resume_from):
                 ck = self._resume_from_mdeltas(resume_from, shard, repl)
+                if ck is None and self.host_stores is not None:
+                    # healing left nothing replayable: restart from
+                    # Init with clean stores
+                    for s in self.host_stores:
+                        s.clear()
             else:
                 ck = self._load_checkpoint(resume_from, shard, repl)
+        if ck is not None:
             frontier, msum, n_f = ck["frontier"], ck["msum"], ck["n_f"]
             visited = ck["visited"]
             distinct, generated, depth = (
@@ -2566,14 +2652,42 @@ class ShardedChecker:
         def grow_visited(v, new_vcap):
             """Grow every store shard: SENT-pad (sorted mode) or rehash
             into a bigger slab (hash mode — slot homes move with the
-            capacity mask, so padding would orphan every entry)."""
+            capacity mask, so padding would orphan every entry).  A
+            hash rehash failure (host OOM, injected fault) DEGRADES to
+            the sorted layout mid-run — the automatic --no-hashstore —
+            instead of dying: the slab's live slots hold exactly the
+            per-shard visited sets, so the conversion is lossless."""
             arr = np.asarray(v).reshape(D, -1)
             if self.use_hashstore:
-                out = hashstore.rebuild_np(arr, new_vcap)
-                self.vcap = new_vcap
-                return jax.device_put(
-                    jnp.asarray(out).reshape(-1), shard
-                )
+                try:
+                    resilience.fault_fire("hashstore.grow")
+                    out = hashstore.rebuild_np(arr, new_vcap)
+                    self.vcap = new_vcap
+                    return jax.device_put(
+                        jnp.asarray(out).reshape(-1), shard
+                    )
+                except Exception as e:  # graftlint: waive[GL003]
+                    # any rehash failure degrades; never mid-run death
+                    print(
+                        f"[resilience] mesh hash-store grow failed "
+                        f"({e}); degrading to the sorted visited "
+                        "layout for the rest of the run",
+                        file=sys.stderr,
+                    )
+                    self.use_hashstore = False
+                    sorted_v = np.full(
+                        (D, new_vcap), np.uint64(SENT)
+                    )
+                    for o in range(D):
+                        live = np.sort(arr[o][arr[o] != SENT])
+                        sorted_v[o, : len(live)] = live
+                    self.vcap = new_vcap
+                    for k in ("level_step", "level_phase1",
+                              "level_phase2", "cap_r", "cap_w"):
+                        self.__dict__.pop(k, None)
+                    return jax.device_put(
+                        jnp.asarray(sorted_v).reshape(-1), shard
+                    )
             pad = np.full((D, new_vcap - arr.shape[1]), np.uint64(SENT))
             self.vcap = new_vcap
             return jax.device_put(
@@ -2643,6 +2757,13 @@ class ShardedChecker:
             return visited
 
         while True:
+            resilience.fault_fire("level.start")
+            if resilience.preempt_requested():
+                # mdelta records are written synchronously on this
+                # path, so the log is already complete — exit resumable
+                raise resilience.Preempted(
+                    checkpoint_dir if checkpoint_every else None, depth
+                )
             if max_depth is not None and depth >= max_depth:
                 break
             if presize and len(level_sizes) > MIN_LEVELS:

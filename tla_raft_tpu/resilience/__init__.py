@@ -1,0 +1,38 @@
+"""Fault-tolerance subsystem: crash-safe checkpoints, self-healing
+resume, deterministic fault injection, graceful degradation.
+
+Layers (docs/ROBUSTNESS.md):
+
+* ``manifest``  — digests, per-directory ``MANIFEST.json``, and
+  ``commit_npz``, the single atomic writer every checkpoint producer
+  routes through (pinned by graftlint GL009).
+* ``recover``   — tmp sweeping, quarantine, truncate-to-good-prefix
+  healing, bounded retry, cooperative preemption.
+* ``faults``    — the deterministic ``FaultPlan``
+  (``TLA_RAFT_FAULT`` / ``--fault``) that makes all of the above
+  testable on CPU in tier-1.
+"""
+
+from .faults import FAULT_SITES, FaultError, FaultPlan  # noqa: F401
+from .faults import fire as fault_fire  # noqa: F401
+from .faults import install as fault_install  # noqa: F401
+from .manifest import (  # noqa: F401
+    Manifest,
+    RunMismatch,
+    adopt_file,
+    commit_npz,
+    digest_file,
+    run_config_fingerprint,
+)
+from .recover import (  # noqa: F401
+    Preempted,
+    clear_preempt,
+    discard_artifacts,
+    heal_log,
+    install_signal_handlers,
+    preempt_requested,
+    quarantine,
+    request_preempt,
+    sweep_tmp,
+    with_retry,
+)

@@ -1,0 +1,177 @@
+"""Deterministic fault injection for the durability layer.
+
+TLC's ``-recover`` earns its keep by surviving hard kills; proving the
+same for this checker needs crashes that happen at EXACTLY the right
+instruction, repeatably, on CPU, in tier-1.  A :class:`FaultPlan` is a
+parsed list of ``site:action@n`` triggers armed from the environment
+(``TLA_RAFT_FAULT``) or the CLI (``--fault``); the durability-critical
+code paths call :func:`fire` at named sites, and the plan performs the
+requested fault when a site's hit counter reaches ``n``:
+
+* ``kill``  — SIGKILL the process (no cleanup, no atexit: the closest
+  userspace approximation of a power cut),
+* ``torn``  — truncate the artifact at the site to half its bytes and
+  continue (a torn write that the kernel half-flushed),
+* ``flip``  — flip one byte in the middle of the artifact and continue
+  (latent media corruption),
+* ``fail``  — raise :class:`FaultError` (a transient error the caller
+  is expected to retry or degrade around).
+
+Sites follow the artifact kinds of the atomic writer
+(``resilience.manifest.commit_npz``): ``<kind>.tmp`` fires after the
+tmp file is fully written but before digest/rename (a kill here leaves
+an orphaned ``.tmp_*`` file and no record), ``<kind>.commit`` fires
+after the rename but before the manifest entry lands (a kill here
+leaves an unmanifested record; ``flip``/``torn`` here corrupt the
+committed file AFTER its digest was recorded — the detectable-latent-
+corruption case).  ``manifest.commit`` fires between the manifest's
+tmp write and its rename.  Non-writer sites: ``hashstore.grow`` (the
+Nth slab grow/rehash), ``exchange.fetch`` (the deep-mode host fetch),
+``level.start`` (the top of each BFS level).
+
+Determinism: counters are per-site and in-process; the Nth hit is the
+Nth call, full stop.  The no-plan fast path is one attribute load and
+a truthiness check, so instrumented hot paths cost nothing in
+production.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+
+# site registry: name -> what firing there means.  Specs naming a site
+# outside this table are rejected at parse time (a typo in a fault spec
+# must not silently test nothing).
+FAULT_SITES = {
+    "delta.tmp": "single-device delta record: tmp written, not renamed",
+    "delta.commit": "single-device delta record: renamed, not manifested",
+    "partial.tmp": "intra-level partial record: tmp written, not renamed",
+    "partial.commit": "intra-level partial record: renamed, not manifested",
+    "mdelta.tmp": "mesh delta record: tmp written, not renamed",
+    "mdelta.commit": "mesh delta record: renamed, not manifested",
+    "hslab.tmp": "hash-slab snapshot: tmp written, not renamed",
+    "hslab.commit": "hash-slab snapshot: renamed, not manifested",
+    "sieve.tmp": "sieve-slab snapshot: tmp written, not renamed",
+    "sieve.commit": "sieve-slab snapshot: renamed, not manifested",
+    "monolith.tmp": "monolith snapshot: tmp written, not renamed",
+    "monolith.commit": "monolith snapshot: renamed, not manifested",
+    "base.commit": "base monolith copied into a delta dir, not manifested",
+    "manifest.commit": "manifest json: tmp written, not renamed",
+    "hashstore.grow": "the Nth visited-slab grow/rehash",
+    "exchange.fetch": "deep-mode quantized-prefix host fetch",
+    "level.start": "top of a BFS level (both engines)",
+}
+
+_ACTIONS = ("kill", "torn", "flip", "fail")
+
+
+class FaultError(RuntimeError):
+    """An injected transient failure (``fail`` action)."""
+
+
+class FaultPlan:
+    """Parsed ``site:action@n`` triggers with per-site hit counters."""
+
+    def __init__(self, spec: str = ""):
+        self.triggers: list[tuple[str, str, int]] = []
+        self.counts: dict[str, int] = {}
+        self.fired: list[str] = []
+        for item in spec.replace(";", ",").split(","):
+            item = item.strip()
+            if not item:
+                continue
+            try:
+                site, action = item.split(":", 1)
+            except ValueError:
+                raise ValueError(
+                    f"fault spec {item!r}: expected site:action[@n]"
+                ) from None
+            n = 1
+            if "@" in action:
+                action, ns = action.split("@", 1)
+                n = int(ns)
+            site, action = site.strip(), action.strip()
+            if site not in FAULT_SITES:
+                raise ValueError(
+                    f"unknown fault site {site!r} (known: "
+                    f"{', '.join(sorted(FAULT_SITES))})"
+                )
+            if action not in _ACTIONS:
+                raise ValueError(
+                    f"unknown fault action {action!r} (known: "
+                    f"{', '.join(_ACTIONS)})"
+                )
+            if n < 1:
+                raise ValueError(f"fault occurrence must be >= 1, got {n}")
+            self.triggers.append((site, action, n))
+
+    def fire(self, site: str, path: str | None = None) -> None:
+        n = self.counts.get(site, 0) + 1
+        self.counts[site] = n
+        for tsite, action, tn in self.triggers:
+            if tsite != site or tn != n:
+                continue
+            self.fired.append(f"{site}:{action}@{n}")
+            self._perform(site, action, n, path)
+
+    def _perform(self, site, action, n, path):
+        note = f"[fault] {site}:{action}@{n}"
+        if action == "kill":
+            print(f"{note} — SIGKILL", file=sys.stderr)
+            sys.stderr.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+        if action == "fail":
+            raise FaultError(f"injected transient failure at {site} (#{n})")
+        if path is None or not os.path.exists(path):
+            raise ValueError(
+                f"fault {site}:{action} needs an artifact path but the "
+                "site fired without one"
+            )
+        size = os.path.getsize(path)
+        if action == "torn":
+            print(f"{note} — truncating {path} to {size // 2} B",
+                  file=sys.stderr)
+            with open(path, "r+b") as fh:
+                fh.truncate(size // 2)
+        elif action == "flip":
+            print(f"{note} — flipping byte {size // 2} of {path}",
+                  file=sys.stderr)
+            with open(path, "r+b") as fh:
+                fh.seek(size // 2)
+                b = fh.read(1)
+                fh.seek(size // 2)
+                fh.write(bytes([b[0] ^ 0xFF]))
+
+
+# The process-wide plan.  ``None`` means "not yet armed from the env";
+# an EMPTY plan (no triggers) is the normal production state.
+_PLAN: FaultPlan | None = None
+
+
+def plan() -> FaultPlan:
+    global _PLAN
+    if _PLAN is None:
+        _PLAN = FaultPlan(os.environ.get("TLA_RAFT_FAULT", ""))
+    return _PLAN
+
+
+def install(spec: str) -> FaultPlan:
+    """Arm a plan explicitly (the CLI's ``--fault``; tests)."""
+    global _PLAN
+    _PLAN = FaultPlan(spec)
+    return _PLAN
+
+
+def reset() -> None:
+    """Disarm (tests)."""
+    global _PLAN
+    _PLAN = FaultPlan("")
+
+
+def fire(site: str, path: str | None = None) -> None:
+    """Hit a fault site (no-op unless a plan targets it)."""
+    p = plan()
+    if p.triggers:
+        p.fire(site, path)
