@@ -79,6 +79,17 @@ for s in "${steps[@]}"; do
         BENCH_MESH="${MESH_DEVICES:-8}" BENCH_MESH_DEEP=1 \
         BENCH_MAX_DEPTH="${SHARDED_DEPTH:-11}" \
         BENCH_FPSTORE=states_mesh_fp BENCH_OUT=BENCH_r06.json \
+        BENCH_NATIVE_DEPTH="${SHARDED_DEPTH:-11}"
+      # serial-chain A/B arm for the async level pipeline (docs/PERF.md
+      # "Async level pipeline"): identical run with BENCH_PIPELINE=0 —
+      # counts must be bit-identical; the wall-clock delta is the
+      # overlap win on a real link
+      run_bench docs/BENCH_SHARDED_SERIAL_r10.json \
+        BENCH_PIPELINE=0 \
+        BENCH_MESH="${MESH_DEVICES:-8}" BENCH_MESH_DEEP=1 \
+        BENCH_MAX_DEPTH="${SHARDED_DEPTH:-11}" \
+        BENCH_FPSTORE=states_mesh_fp_serial \
+        BENCH_OUT=BENCH_SERIAL_r10.json \
         BENCH_NATIVE_DEPTH="${SHARDED_DEPTH:-11}" ;;
   esac
 done

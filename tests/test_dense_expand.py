@@ -15,6 +15,8 @@ from tla_raft_tpu.models.raft import from_oracle
 from tla_raft_tpu.ops.successor import SuccessorKernel
 from tla_raft_tpu.oracle.explicit import init_state, successors
 
+from refenv import requires_reference
+
 CFGS = [
     RaftConfig(n_servers=2, n_vals=1, max_election=2, max_restart=1),
     RaftConfig(n_servers=3, n_vals=2, max_election=2, max_restart=1),
@@ -68,6 +70,7 @@ def test_dense_matches_scalar(cfg):
 
 
 @pytest.mark.slow
+@requires_reference
 def test_dense_matches_scalar_s5():
     import dataclasses
 

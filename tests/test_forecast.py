@@ -17,6 +17,8 @@ from tla_raft_tpu.engine.forecast import (
     pow2ceil,
 )
 
+from refenv import requires_reference
+
 # the deepest verified per-level record (bench.py GOLDEN_LEVELS /
 # BASELINE.md): levels 0..28 of the as-is reference config
 GOLDEN = [
@@ -75,6 +77,7 @@ def test_forecast_no_signal():
 
 
 @pytest.mark.slow
+@requires_reference
 def test_jax_checker_presize_parity(monkeypatch):
     """Forced-on presize floors must not change any count: the floors
     only pad shapes (frontier capacity, visited trim, merge width)."""

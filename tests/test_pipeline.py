@@ -1,0 +1,399 @@
+"""Async intra-level pipeline (engine/pipeline.py): the ISSUE-5 gates.
+
+* bit-identical ``distinct/depth/level_sizes`` between
+  ``TLA_RAFT_PIPELINE=0`` and ``=1`` — single-device (all three store
+  tiers) and mesh-deep (the depth-8 golden prefix 1505/3044); the
+  GOLDEN_FULL (3,1,2,1) fixpoint A/B rides in the slow tier,
+* the window DRAINS at the level boundary: no store insert ever runs
+  with fetch groups still in flight,
+* crash mid-window (the ``pipeline.window`` fault site) + ``--recover``
+  reproduces the uninterrupted run exactly,
+* a GRAFT_SANITIZE smoke run with the pipeline AND the prewarm on:
+  zero post-warmup recompiles (prewarm compiles are declared) and zero
+  unledgered transfers (every async fetch completes through the
+  ledgered get),
+* AsyncFetchWindow / Prewarmer mechanics (ordering, drain, discard,
+  dedupe, failure counting).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.engine import pipeline as gpipe
+from tla_raft_tpu.native import HostFPStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+S2 = RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1)
+S3121 = RaftConfig(n_vals=1, max_election=2, max_restart=1)
+REF = RaftConfig()  # the reference constants (deep golden prefix)
+
+
+def _triple(r):
+    return (r.distinct, r.generated, r.depth, tuple(r.level_sizes))
+
+
+# -- AsyncFetchWindow mechanics -------------------------------------------
+
+def test_window_bounded_inflight_and_order():
+    win = gpipe.AsyncFetchWindow(2)
+    done = []
+    for i in range(5):
+        win.submit(np.asarray([i]), lambda h, i=i: done.append(i))
+        assert win.inflight <= 2
+    # 5 submitted, window 2 -> the 3 oldest completed, IN ORDER
+    assert done == [0, 1, 2]
+    win.drain()
+    assert done == [0, 1, 2, 3, 4]
+    assert win.inflight == 0
+    assert gpipe.AsyncFetchWindow.live == 0
+    # the transient peak is window+1: the newest group's copies start
+    # before the oldest completes (what the dev-budget headroom prices)
+    assert win.max_inflight == 3
+
+
+def test_window_zero_is_serial():
+    win = gpipe.AsyncFetchWindow(0)
+    done = []
+    win.submit(np.asarray([7]), lambda h: done.append(int(h[0])))
+    assert done == [7]  # completed AT submit — the serial chain
+    assert win.inflight == 0
+
+
+def test_window_discard_completes_without_consume():
+    win = gpipe.AsyncFetchWindow(3)
+    done = []
+    win.submit(np.asarray([1]), lambda h: done.append(1))
+    win.submit(np.asarray([2]), lambda h: done.append(2))
+    win.discard()
+    assert done == []  # fetches finished, consumers never ran
+    assert win.inflight == 0
+    assert gpipe.AsyncFetchWindow.live == 0
+
+
+def test_window_fetches_device_arrays():
+    import jax.numpy as jnp
+
+    win = gpipe.AsyncFetchWindow(1)
+    got = {}
+    win.submit(
+        (jnp.arange(4), jnp.asarray(2.0)), lambda h: got.update(h=h)
+    )
+    win.drain()
+    assert list(got["h"][0]) == [0, 1, 2, 3]
+    assert isinstance(got["h"][0], np.ndarray)
+
+
+def test_prewarmer_dedupes_counts_and_survives_failures():
+    pw = gpipe.Prewarmer()
+    ran = []
+
+    def ok(k):
+        return lambda: ran.append(k)
+
+    def boom():
+        raise RuntimeError("planted")
+
+    n = pw.submit([("a", ok("a")), ("b", ok("b")), ("bad", boom)])
+    assert n == 3
+    # resubmitting known keys queues nothing new
+    assert pw.submit([("a", ok("a")), ("c", ok("c"))]) == 1
+    pw.join(30)
+    assert sorted(ran) == ["a", "b", "c"]
+    assert pw.n_ok == 3 and pw.n_failed == 1
+
+
+# -- single-device parity: serial vs pipelined ----------------------------
+
+@pytest.mark.parametrize("hs", [False, True])
+def test_engine_parity_3121_prefix_pipelined(hs):
+    a = JaxChecker(
+        S3121, chunk=256, use_hashstore=hs, pipeline=False,
+    ).run(max_depth=9)
+    b = JaxChecker(
+        S3121, chunk=256, use_hashstore=hs, pipeline=True,
+        pipeline_window=2,
+    ).run(max_depth=9)
+    assert _triple(a) == _triple(b)
+    assert a.action_counts == b.action_counts
+
+
+@pytest.mark.slow
+def test_engine_parity_hosted_pipelined(tmp_path):
+    """External-store path (the per-group fetch window lives here):
+    serial vs pipelined vs a deeper window, all bit-identical.
+
+    slow tier: the fast tier keeps hosted+pipelined coverage through
+    test_window_drains_before_store_insert (full S2 run, exact distinct)
+    and the CI pipeline job's tiny-config A/B; this deeper S3121 A/B
+    rides with the other heavy parity rows so tier-1 stays inside its
+    wall-clock budget."""
+    runs = []
+    for i, (pipe, wdw) in enumerate([(False, 0), (True, 2), (True, 4)]):
+        runs.append(JaxChecker(
+            S3121, chunk=64,
+            host_store=HostFPStore(str(tmp_path / f"fps{i}")),
+            pipeline=pipe, pipeline_window=wdw,
+        ).run(max_depth=8))
+    assert _triple(runs[0]) == _triple(runs[1]) == _triple(runs[2])
+
+
+@pytest.mark.slow
+def test_engine_parity_golden_full_3121_pipelined():
+    """GOLDEN_FULL acceptance A/B: the pipelined run lands exactly on
+    the dual-verified (3,1,2,1) fixpoint totals, bit-identical to the
+    serial chain."""
+    a = JaxChecker(S3121, chunk=1024, pipeline=False).run()
+    b = JaxChecker(S3121, chunk=1024, pipeline=True).run()
+    assert _triple(a) == _triple(b)
+    assert (b.distinct, b.generated, b.depth) == (180_582, 747_500, 35)
+
+
+# -- prewarm: forecast AOT compiles, declared and harmless ----------------
+
+def test_prewarm_compiles_forecast_ladder():
+    chk = JaxChecker(S3121, chunk=256, prewarm=True, pipeline=True)
+    res = chk.run(max_depth=9)
+    assert res.ok
+    pw = chk._prewarmer
+    assert pw is not None, "prewarm never submitted a plan"
+    pw.join(120)
+    assert pw.pending == 0
+    assert pw.n_ok > 0, "prewarm compiled nothing"
+    assert pw.n_failed == 0, "prewarm thunks failed"
+    # a second identical run must be bit-identical (prewarm is a pure
+    # optimization)
+    ref = JaxChecker(S3121, chunk=256, prewarm=False).run(max_depth=9)
+    assert _triple(res) == _triple(ref)
+
+
+# -- the level-boundary drain invariant -----------------------------------
+
+def test_window_drains_before_store_insert(tmp_path, monkeypatch):
+    """No store insert may run with fetch groups in flight: candidates
+    still streaming could otherwise filter against half a level's
+    inserts.  AsyncFetchWindow.live counts in-flight groups across all
+    instances; it must be 0 at EVERY insert."""
+    seen = []
+    real_insert = HostFPStore.insert
+
+    def checked_insert(self, fps):
+        seen.append(gpipe.AsyncFetchWindow.live)
+        return real_insert(self, fps)
+
+    monkeypatch.setattr(HostFPStore, "insert", checked_insert)
+    res = JaxChecker(
+        S2, chunk=64, host_store=HostFPStore(str(tmp_path / "fps")),
+        pipeline=True, pipeline_window=2,
+    ).run()
+    assert res.ok and res.distinct == 50
+    assert len(seen) > 0
+    assert set(seen) == {0}, f"insert ran with window open: {seen}"
+
+
+def test_partial_records_note_window_state(tmp_path):
+    """meta[8] of a partial record carries the in-flight window (the
+    crash-replay bound: a kill loses at most one window of groups)."""
+    chk = JaxChecker(
+        S2, chunk=64, host_store=HostFPStore(str(tmp_path / "fps")),
+        pipeline=True, pipeline_window=3,
+    )
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    chk._save_partial(
+        ck, 1, 0, np.zeros(2, np.uint64), np.zeros(2, np.uint64),
+        np.zeros(2, np.int64), np.zeros(chk.K, np.int64), 1,
+    )
+    z = np.load(os.path.join(ck, "partial_0001_00000.npz"))
+    assert int(z["meta"][8]) == 3
+    # serial runs record window 0
+    chk0 = JaxChecker(
+        S2, chunk=64, host_store=HostFPStore(str(tmp_path / "fps0")),
+        pipeline=False,
+    )
+    chk0._save_partial(
+        ck, 2, 0, np.zeros(2, np.uint64), np.zeros(2, np.uint64),
+        np.zeros(2, np.int64), np.zeros(chk0.K, np.int64), 1,
+    )
+    z0 = np.load(os.path.join(ck, "partial_0002_00000.npz"))
+    assert int(z0["meta"][8]) == 0
+
+
+# -- mesh parity: serial vs pipelined -------------------------------------
+
+@pytest.mark.slow
+def test_mesh_parity_pipelined():
+    if len(jax.devices()) < 4:
+        pytest.skip("not enough virtual devices")
+    from tla_raft_tpu.parallel import ShardedChecker, make_mesh
+
+    mesh = make_mesh(4)
+    a = ShardedChecker(S2, mesh, cap_x=256, pipeline=False).run()
+    b = ShardedChecker(
+        S2, mesh, cap_x=256, pipeline=True, pipeline_window=2,
+    ).run()
+    assert _triple(a) == _triple(b)
+    assert a.action_counts == b.action_counts
+
+
+@pytest.mark.slow
+def test_mesh_deep_golden_prefix_pipelined(tmp_path):
+    """Mesh-deep acceptance A/B: serial vs pipelined on the depth-8
+    golden prefix — both must land on 1505 distinct / 3044 generated
+    (BASELINE.md), bit-identical level for level."""
+    if len(jax.devices()) < 8:
+        pytest.skip("not enough virtual devices")
+    from tla_raft_tpu.parallel import ShardedChecker, make_mesh
+
+    mesh = make_mesh(8)
+    a = ShardedChecker(
+        REF, mesh, cap_x=512, deep=True, seg_rows=128,
+        host_store_dir=str(tmp_path / "fpa"), pipeline=False,
+    ).run(max_depth=8)
+    b = ShardedChecker(
+        REF, mesh, cap_x=512, deep=True, seg_rows=128,
+        host_store_dir=str(tmp_path / "fpb"), pipeline=True,
+        pipeline_window=2,
+    ).run(max_depth=8)
+    assert _triple(a) == _triple(b)
+    assert (b.distinct, b.generated, b.depth) == (1505, 3044, 8)
+    assert list(b.level_sizes) == [1, 1, 3, 9, 22, 57, 136, 345, 931]
+
+
+# -- crash mid-window + recover (the PR-4 fault plan) ---------------------
+
+def _run_cli(args, fault=None, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if fault is not None:
+        env["TLA_RAFT_FAULT"] = fault
+    else:
+        env.pop("TLA_RAFT_FAULT", None)
+    return subprocess.run(
+        [sys.executable, "-m", "tla_raft_tpu.check", *args],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=REPO,
+    )
+
+
+def _json_line(proc):
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    raise AssertionError(
+        f"no JSON summary in output:\n{proc.stdout}\n{proc.stderr}"
+    )
+
+
+# the (2,1,1,1) model the CLI suite pins at 50 distinct / depth 12 —
+# MaxTerm, SYMMETRY and VIEW must match tests/test_resilience.CFG_2111
+# (dropping them describes a DIFFERENT model with a 99-state fixpoint)
+TINY_CFG = """\
+CONSTANTS
+  MaxTerm = 3
+  MaxRestart = 1
+  MaxElection = 1
+  Servers = {s1, s2}
+  Vals = {v1}
+SYMMETRY symmServers
+VIEW view
+INIT Init
+NEXT Next
+INVARIANT Inv
+"""
+
+
+@pytest.mark.parametrize(
+    "nth", [2, pytest.param(5, marks=pytest.mark.slow)]
+)
+def test_crash_mid_window_recovers_bit_identical(tmp_path, nth):
+    """SIGKILL at the Nth fetch-group submit (``pipeline.window``), with
+    up to a window of groups dispatched but unconsumed; --recover must
+    reproduce the uninterrupted run exactly (the external-store path:
+    partials + window both in play)."""
+    cfg = tmp_path / "tiny.cfg"
+    cfg.write_text(TINY_CFG)
+    ck = str(tmp_path / "ck")
+    common = [
+        "--config", str(cfg), "--chunk", "64",
+        "--fpstore-dir", str(tmp_path / "fps"),
+        "--checkpoint-dir", ck, "--log", "-", "--json",
+        "--pipeline-window", "2",
+    ]
+    killed = _run_cli(common, fault=f"pipeline.window:kill@{nth}")
+    assert killed.returncode != 0, "the planted kill never fired"
+    rec = _run_cli(common + ["--recover", ck])
+    assert rec.returncode == 0, rec.stdout[-2000:] + rec.stderr[-2000:]
+    got = _json_line(rec)
+    # the uninterrupted (2,1,1,1) fixpoint the CLI suite pins
+    assert (got["ok"], got["distinct"], got["depth"]) == (True, 50, 12)
+    assert sum(got["level_sizes"]) == 50
+
+
+@pytest.mark.slow
+def test_crash_mid_window_device_path_recovers(tmp_path):
+    """Same site on the device-store path (the level-tail window).
+
+    slow tier: the fast tier keeps the pipeline.window kill+recover
+    gate through the external-store case above (same fault site, same
+    recovery machinery) — this second subprocess pair rides with the
+    heavy rows to keep tier-1 inside its wall-clock budget."""
+    cfg = tmp_path / "tiny.cfg"
+    cfg.write_text(TINY_CFG)
+    ck = str(tmp_path / "ck")
+    common = [
+        "--config", str(cfg), "--chunk", "64",
+        "--checkpoint-dir", ck, "--log", "-", "--json",
+    ]
+    killed = _run_cli(common, fault="pipeline.window:kill@4")
+    assert killed.returncode != 0, "the planted kill never fired"
+    rec = _run_cli(common + ["--recover", ck])
+    assert rec.returncode == 0, rec.stdout[-2000:] + rec.stderr[-2000:]
+    got = _json_line(rec)
+    assert (got["ok"], got["distinct"], got["depth"]) == (True, 50, 12)
+
+
+# -- sanitizer smoke: pipeline + prewarm on -------------------------------
+
+def test_sanitize_smoke_pipelined_with_prewarm(tmp_path):
+    """GRAFT_SANITIZE acceptance with the pipeline AND prewarm on: zero
+    post-warmup recompiles (prewarm compiles land in the declared
+    ledger), zero unledgered transfers, zero unledgered async fetches
+    (every window fetch completed through the ledgered get)."""
+    cfg = tmp_path / "tiny.cfg"
+    cfg.write_text(TINY_CFG)
+    env = dict(os.environ)
+    env.update(
+        GRAFT_SANITIZE="1", JAX_PLATFORMS="cpu",
+        TLA_RAFT_PIPELINE="1", TLA_RAFT_PREWARM="1",
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "tla_raft_tpu.check",
+         "--config", str(cfg), "--chunk", "64",
+         "--pipeline-window", "2",
+         "--log", str(tmp_path / "raft.log")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "Sanitizer: OK" in proc.stdout
+    assert "0 post-warmup unexpected recompiles" in proc.stdout
+    assert "0 unledgered host transfers" in proc.stdout
+    assert "(0 unledgered)" in proc.stdout  # async fetch ledger balanced
+    assert "Model checking completed" in proc.stdout
+    # the pipeline actually ran fetch groups through the window
+    m = [ln for ln in proc.stdout.splitlines()
+         if "async pipeline fetches" in ln]
+    assert m, proc.stdout
+    n_async = int(m[0].split("Sanitizer: ")[1].split()[0])
+    assert n_async > 0, m[0]

@@ -14,6 +14,8 @@ from tla_raft_tpu.config import RaftConfig
 from tla_raft_tpu.oracle import OracleChecker
 from tla_raft_tpu.parallel import ShardedChecker, make_mesh
 
+from refenv import requires_reference
+
 pytestmark = pytest.mark.slow
 
 CFGS = [
@@ -197,6 +199,7 @@ def test_sharded_host_store_requires_a2a(tmp_path):
         )
 
 
+@requires_reference
 def test_sharded_presize_prevents_reactive_growth():
     """Predictive capacity sizing (VERDICT r4 #7): with deliberately tiny
     initial caps, the engine must forecast-resize at a level BOUNDARY
@@ -224,6 +227,7 @@ def test_sharded_presize_prevents_reactive_growth():
     )
 
 
+@requires_reference
 def test_children_are_owner_balanced(tmp_path):
     """The owner-shipping exchange must spread the next frontier across
     the mesh (rounds 2-4 kept children with their parents, so the whole

@@ -70,6 +70,32 @@ def assert_device_dispatch_ok(what: str = "device dispatch") -> None:
         )
 
 
+def mark_thread_compiles_declared() -> None:
+    """Mark the CURRENT thread's XLA compiles as declared.
+
+    The AOT prewarm thread (engine/pipeline.Prewarmer) calls this once:
+    its compiles are the POINT of the thread, so the compile listener
+    books them to the prewarm ledger instead of the per-level
+    silent-retrace check (which audits the main dispatch thread)."""
+    _tl.declared_compiles = True
+
+
+def thread_compiles_declared() -> bool:
+    return getattr(_tl, "declared_compiles", False)
+
+
+def note_async_fetch_start() -> None:
+    """The async pipeline started one fetch group (copy_to_host_async)."""
+    if CURRENT is not None:
+        CURRENT.n_async_started += 1
+
+
+def note_async_fetch_complete() -> None:
+    """One async fetch group completed through the ledgered get path."""
+    if CURRENT is not None:
+        CURRENT.n_async_completed += 1
+
+
 # -- engine hooks (no-ops unless a Sanitizer is active) -------------------
 
 def level_tick() -> None:
@@ -123,6 +149,15 @@ class Sanitizer:
         self.ledgered_bytes = 0
         self.n_implicit = 0
         self.n_worker_dispatch = 0
+        # async-pipeline fetch groups (engine/pipeline.py): every
+        # copy_to_host_async group must complete through the ledgered
+        # device_get path — started minus completed is the count of
+        # fetches that bypassed the ledger (must be 0 on clean runs)
+        self.n_async_started = 0
+        self.n_async_completed = 0
+        # declared background (prewarm-thread) compiles — counted apart
+        # from the per-level retrace check, which audits the main thread
+        self.compiles_prewarm = 0
         self.violations: list[str] = []
         self._patches: list[tuple[object, str, object]] = []
         self._listener = None
@@ -160,6 +195,12 @@ class Sanitizer:
             if self._active and name == (
                 "/jax/core/compile/backend_compile_duration"
             ):
+                # the event fires ON the compiling thread, so the
+                # prewarm thread's declared marker routes its compiles
+                # race-free to the prewarm ledger
+                if thread_compiles_declared():
+                    self.compiles_prewarm += 1
+                    return
                 self.compiles_total += 1
                 self._level_compiles += 1
 
@@ -172,7 +213,8 @@ class Sanitizer:
             class _H(logging.Handler):
                 def emit(h, record):  # noqa: N805
                     msg = record.getMessage()
-                    if self._active and msg.startswith("Compiling "):
+                    if (self._active and msg.startswith("Compiling ")
+                            and not thread_compiles_declared()):
                         self._level_names.append(msg.split()[1])
 
             self._log_prev = jax.config.jax_log_compiles
@@ -315,11 +357,18 @@ class Sanitizer:
     # -- reporting -------------------------------------------------------
 
     @property
+    def unledgered_async_fetches(self) -> int:
+        """Async fetch groups started but never completed through the
+        ledgered get path (a drain/discard hole in the pipeline)."""
+        return max(0, self.n_async_started - self.n_async_completed)
+
+    @property
     def ok(self) -> bool:
         return (
             not self.violations
             and self.n_implicit == 0
             and self.n_worker_dispatch == 0
+            and self.unledgered_async_fetches == 0
         )
 
     def report(self) -> dict:
@@ -328,11 +377,14 @@ class Sanitizer:
             levels=self.level,
             warmup_levels=self.warmup_levels,
             compiles_total=self.compiles_total,
+            prewarm_compiles=self.compiles_prewarm,
             unexpected_recompiles=len(self.violations),
             ledgered_device_get=self.n_ledgered_get,
             ledgered_device_put=self.n_ledgered_put,
             ledgered_bytes=self.ledgered_bytes,
             unledgered_transfers=self.n_implicit,
+            async_fetches=self.n_async_completed,
+            unledgered_async_fetches=self.unledgered_async_fetches,
             worker_thread_dispatches=self.n_worker_dispatch,
             violations=list(self.violations),
         )
@@ -353,6 +405,12 @@ class Sanitizer:
             f"{r['unledgered_transfers']} unledgered host transfers, "
             f"{r['worker_thread_dispatches']} worker-thread device "
             "dispatches.",
+            file=out,
+        )
+        print(
+            f"Sanitizer: {r['async_fetches']} async pipeline fetches "
+            f"({r['unledgered_async_fetches']} unledgered), "
+            f"{r['prewarm_compiles']} declared prewarm compiles.",
             file=out,
         )
         for v in r["violations"]:

@@ -255,6 +255,24 @@ def main(argv=None) -> int:
     p.add_argument("--no-compress", action="store_true",
                    help="deep mode: fetch raw u64 fingerprints instead of "
                         "the delta-packed stream")
+    p.add_argument("--no-pipeline", action="store_true",
+                   help="disable the async intra-level pipeline "
+                        "(overlapped expand/fetch/insert windows) — the "
+                        "serial fetch-after-dispatch chain; counts are "
+                        "bit-identical either way (env: "
+                        "TLA_RAFT_PIPELINE=0)")
+    p.add_argument("--pipeline-window", type=int, default=None,
+                   metavar="N",
+                   help="bounded in-flight fetch groups of the async "
+                        "pipeline (default 2; 0 = serial; env: "
+                        "TLA_RAFT_PIPELINE_WINDOW)")
+    p.add_argument("--prewarm", type=int, choices=(0, 1), default=None,
+                   help="forecast-driven AOT program prewarm: compile "
+                        "the deep-level shape ladder in a background "
+                        "thread while shallow levels run (default: on "
+                        "for tunneled backends; env: TLA_RAFT_PREWARM; "
+                        "single-device engine only — ignored with "
+                        "--mesh)")
     p.add_argument("--no-hashstore", action="store_true",
                    help="revert to the sort-based visited path (lexsort "
                         "+ searchsorted + sorted merge) instead of the "
@@ -388,6 +406,10 @@ def main(argv=None) -> int:
             contextlib.nullcontext()
         )
         if args.mesh:
+            if args.prewarm:
+                print("--prewarm applies to the single-device engine "
+                      "only; the mesh level loops compile their program "
+                      "set in line (flag ignored)", file=out)
             if args.mesh_deep and not args.fpstore_dir:
                 print("--mesh-deep requires --fpstore-dir (the sharded "
                       "deep sweep filters through per-owner external "
@@ -407,6 +429,8 @@ def main(argv=None) -> int:
                 deep=args.mesh_deep, seg_rows=args.seg_rows,
                 sieve=not args.no_sieve, compress=not args.no_compress,
                 use_hashstore=not args.no_hashstore,
+                pipeline=False if args.no_pipeline else None,
+                pipeline_window=args.pipeline_window,
             )
             try:
                 with sanctx:
@@ -445,6 +469,12 @@ def main(argv=None) -> int:
                         cfg, chunk=args.chunk, progress=progress,
                         host_store=host_store, canon=args.canon,
                         use_hashstore=not args.no_hashstore,
+                        pipeline=False if args.no_pipeline else None,
+                        pipeline_window=args.pipeline_window,
+                        prewarm=(
+                            None if args.prewarm is None
+                            else bool(args.prewarm)
+                        ),
                     ).run(
                         max_depth=args.max_depth,
                         checkpoint_dir=args.checkpoint_dir,

@@ -71,6 +71,7 @@ from ..config import RaftConfig
 from ..models.raft import RaftState, init_batch, to_oracle
 from ..ops import hashstore
 from ..ops.successor import SuccessorKernel, get_kernel
+from . import pipeline as graft_pipeline
 from .forecast import MIN_LEVELS as PRESIZE_MIN_LEVELS, pow2ceil as _pow2
 from .invariants import resolve_invariant_kernel
 
@@ -453,6 +454,9 @@ class JaxChecker:
         cap_m: int = 96,
         canon: str = "late",
         use_hashstore: bool | None = None,
+        pipeline: bool | None = None,
+        pipeline_window: int | None = None,
+        prewarm: bool | None = None,
     ):
         # canon="late": expand computes guards only; the compacted
         # candidates are materialized and fingerprinted with the full-state
@@ -527,11 +531,40 @@ class JaxChecker:
         # segments demote to host RAM and page back in on demand — the
         # tier that breaks the single-frontier-in-HBM wall at level 29 of
         # the reference sweep (BASELINE.md).  The budget prices LIVE
-        # buffers only; the expand walk's one-entry parent page cache and
-        # the paged-parent fetch buffer are transient extras on top, so
-        # set the budget with a few segments of headroom below physical
-        # HBM (run_sweep.sh's 11 GB of 16 GB leaves ~45 segments' worth)
+        # buffers only — MULTI-SEGMENT HEADROOM IS REQUIRED: the expand
+        # walk's one-entry parent page cache and the paged-parent fetch
+        # buffer are transient extras the estimate does not count, and
+        # with the async pipeline on, each in-flight window group pins
+        # its group-output fetch buffers and keeps its parent segment
+        # referenced ~window groups longer (the estimate below adds the
+        # window to the live count, the page caches stay uncounted) —
+        # so set the budget several segments below physical HBM
+        # (run_sweep.sh's 11 GB of 16 GB leaves ~45 segments' worth)
         self.dev_budget = int(float(os.environ.get("TLA_RAFT_DEV_BYTES", "0")))
+        # async intra-level pipeline (engine/pipeline.py): overlap the
+        # device expand dispatch, the device->host group fetches and the
+        # host-side tail under a bounded in-flight window.  Default ON;
+        # TLA_RAFT_PIPELINE=0 (or pipeline=False / a window < 1) reverts
+        # to the serial fetch-after-dispatch chain — counts are
+        # bit-identical either way (the parity tests diff the two).
+        if pipeline is None:
+            pipeline = graft_pipeline.enabled_by_env()
+        if pipeline_window is None:
+            pipeline_window = graft_pipeline.window_from_env()
+        self.pipeline_window = int(pipeline_window)
+        self.pipeline = bool(pipeline) and self.pipeline_window >= 1
+        # forecast-driven AOT prewarm (engine/pipeline.Prewarmer): once
+        # the growth model has signal, compile the deep-level program
+        # set at the forecast capacity ladder in a background thread
+        # while the cheap shallow levels run.  Worth it exactly where
+        # presize is: on tunneled backends whose remote compiles are
+        # minutes each (the payoff routes through the persistent
+        # compilation cache, so supervised relaunches also skip them).
+        env_pw = os.environ.get("TLA_RAFT_PREWARM")
+        if prewarm is None:
+            prewarm = bool(int(env_pw)) if env_pw else _is_tunneled()
+        self.prewarm = bool(prewarm)
+        self._prewarmer = None  # built lazily at first plan submit
         self.paged_out = 0  # sealed child segments demoted to host RAM
         if host_store is not None and chunk > SEG_ROWS:
             # the segment walkers assume chunks never straddle segment
@@ -1091,6 +1124,165 @@ class JaxChecker:
             min(_pow2(int(peak * 1.05) + 1), _pow2(budget // 16)),
         )
 
+    # -- forecast-driven AOT prewarm (engine/pipeline.Prewarmer) ----------
+
+    def _frontier_struct(self, template, cap: int):
+        """ShapeDtypeStruct tree of a ``cap``-row frontier, field shapes
+        and dtypes taken from a live frontier/segment (``template``)."""
+        if isinstance(template, list):
+            template = template[0]
+        if isinstance(template, _HostSeg):
+            fields = template.fields
+            return Frontier(**{
+                f: jax.ShapeDtypeStruct((cap,) + v.shape[1:], v.dtype)
+                for f, v in fields.items()
+            })
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct((cap,) + x.shape[1:], x.dtype),
+            template,
+        )
+
+    def _prewarm_plan(self, level_sizes, distinct, max_depth, frontier,
+                      visited):
+        """(key, thunk) pairs compiling the deep-level program set at the
+        forecast shape ladder (``jit(...).lower(...).compile()``).
+
+        The thunks never dispatch a device program (inputs are avals),
+        so running them on the Prewarmer's background thread does not
+        break the all-dispatch-on-the-main-thread rule; the payoff
+        routes through the persistent compilation cache
+        (platform.setup_jax), which a supervised relaunch also reads.
+        Shapes come from the SAME quantizers the level loop uses
+        (_frontier_cap/_cap_steps/_cap4/slab_rows), so a sharp forecast
+        prewarms exactly the programs the deep levels will request."""
+        from .forecast import pow2_ladder, shape_plan
+
+        rows = shape_plan(level_sizes, max_depth)
+        if not rows:
+            return []
+        plan: list = []
+        s_i64 = jax.ShapeDtypeStruct((), jnp.int64)
+        final = distinct + sum(rows)
+
+        def u64(n):
+            return jax.ShapeDtypeStruct((n,), jnp.uint64)
+
+        def i64(n):
+            return jax.ShapeDtypeStruct((n,), jnp.int64)
+
+        # 1) the expand-span program at the frontier-capacity ladder (the
+        # big one: its compile is the round-3 minutes-class cost).  The
+        # external-store path walks uniform SEG_ROWS segments once the
+        # frontier exceeds one segment, so its ladder collapses there.
+        if self.chunk >= self.span_min_chunk and not self.orbit:
+            caps = set()
+            for r in rows:
+                if self.host_store is not None:
+                    caps.add(min(_host_cap(r, self.chunk), SEG_ROWS))
+                else:
+                    caps.add(self._frontier_cap(r))
+            for c in sorted(caps):
+                fs = self._frontier_struct(frontier, c)
+                plan.append((
+                    ("span", c),
+                    lambda fs=fs: self._expand_span.lower(
+                        fs, s_i64, s_i64, s_i64
+                    ).compile(),
+                ))
+        if self.host_store is not None:
+            # host path: per-group dedup runs at the FIXED G*cap_x lane
+            # width (compiled by the first big level); nothing else on
+            # the device scales with depth
+            return plan
+        # 2) the level-dedup program at the lane-count ladder, against
+        # the visited structure at its forecast capacity
+        lanes = set()
+        for r in rows:
+            n_chunks = -(-max(int(r), 1) // self.chunk)
+            if n_chunks > 16 * self.G:  # the level loop's grouping rule
+                lanes.add(_cap_steps((-(-n_chunks // self.G)) * self.cap_g))
+            else:
+                lanes.add(_cap_steps(n_chunks * self.cap_x))
+        if self.use_hashstore:
+            scaps = pow2_ladder(
+                self.hstore.cap // 2, hashstore.slab_rows(final)
+            ) or [self.hstore.cap]
+            for sc in scaps:
+                for L in sorted(lanes):
+                    plan.append((
+                        ("dedup_hash", L, sc),
+                        lambda L=L, sc=sc: _level_dedup_hash.lower(
+                            u64(L), u64(L), i64(L), u64(sc)
+                        ).compile(),
+                    ))
+                plan.append((
+                    ("gfilter_hash", sc, self.cap_g),
+                    lambda sc=sc: _group_filter_hash.lower(
+                        u64(self.G * self.cap_x), u64(self.G * self.cap_x),
+                        i64(self.G * self.cap_x), u64(sc),
+                        cap_g=self.cap_g,
+                    ).compile(),
+                ))
+        else:
+            vcap_now = visited.shape[0]
+            vcaps = pow2_ladder(
+                vcap_now // 2,
+                max(_cap4(final + 1), self._presize_vcap),
+            ) or [vcap_now]
+            vcaps = [v for v in vcaps if v == _cap4(v)]  # store is pow4
+            for vc in vcaps:
+                for L in sorted(lanes):
+                    plan.append((
+                        ("dedup", L, vc),
+                        lambda L=L, vc=vc: _level_dedup.lower(
+                            u64(L), u64(L), i64(L), u64(vc)
+                        ).compile(),
+                    ))
+                plan.append((
+                    ("gfilter", vc, self.cap_g),
+                    lambda vc=vc: _group_filter.lower(
+                        u64(self.G * self.cap_x), u64(self.G * self.cap_x),
+                        i64(self.G * self.cap_x), u64(vc),
+                        cap_g=self.cap_g,
+                    ).compile(),
+                ))
+                # 3) the store merge at its forecast input widths
+                for r in set(rows):
+                    w = max(_pow2(int(r)), self.chunk)
+                    if self._presize_merge:
+                        w = max(w, self._presize_merge)
+                    plan.append((
+                        ("merge", vc, w),
+                        lambda vc=vc, w=w: _merge_sorted.lower(
+                            u64(vc), u64(w)
+                        ).compile(),
+                    ))
+        return plan
+
+    def _submit_prewarm(self, level_sizes, distinct, max_depth, frontier,
+                        visited):
+        """Queue the forecast program set on the background compiler."""
+        try:
+            plan = self._prewarm_plan(
+                level_sizes, distinct, max_depth, frontier, visited
+            )
+        except Exception as e:  # graftlint: waive[GL003] — plan building
+            # is a pure optimization; a forecast edge case must never
+            # take the run down (the shapes then compile in line)
+            print(f"[pipeline] prewarm plan failed: {e}", file=sys.stderr)
+            return
+        if not plan:
+            return
+        if self._prewarmer is None or self._prewarmer.stopped:
+            self._prewarmer = graft_pipeline.Prewarmer()
+        self._prewarmer.submit(plan)
+        # deliberately NO note_shape_event here: the background thread's
+        # thread-local marker already diverts every prewarm compile to
+        # the declared ledger before the per-level counter sees it, and
+        # a submission note would blanket-excuse a genuine MAIN-thread
+        # silent retrace at this level — the exact regression class the
+        # sanitizer exists to catch
+
     def _materialize_segs(self, segs, pay_np, new_payload, n_new):
         """Segment-streamed materialize for the external-store path.
 
@@ -1197,6 +1389,11 @@ class JaxChecker:
                             if d is not None and not isinstance(d, _HostSeg)
                         )
                         + 2  # the transient concat + one in-flight slice
+                        # the async pipeline keeps up to a window's
+                        # worth of group fetch buffers (and their parent
+                        # segments) alive through the NEXT expand — price
+                        # that peak here so demotion leaves room for it
+                        + (self.pipeline_window if self.pipeline else 0)
                     )
                     if (live + 1) * seg_b > self.dev_budget:
                         sealed = self._seg_to_host(sealed)
@@ -1992,7 +2189,53 @@ class JaxChecker:
                 page["j"], page["dev"] = sj, self._seg_to_dev(s)
             return page["dev"]
 
+        # async group window (engine/pipeline.py): group gi's padded
+        # fetch starts with copy_to_host_async and completes — through
+        # the LEDGERED device_get — only after group gi+1..gi+W have
+        # been dispatched, so the device expands the next groups while
+        # the previous ones stream over the (4 MB/s tunneled) host link
+        # and the host tail (slice/append + partial save) runs.  All
+        # dispatch stays on this (the main) thread; window 0 == the
+        # serial fetch-after-dispatch chain, bit-identically.
+        win = graft_pipeline.AsyncFetchWindow(
+            self.pipeline_window if self.pipeline else 0
+        )
+        stop: dict = {}
+
+        def consume(gi_, host):
+            nonlocal mult_np
+            n_u, ab, ovf_h, mult_g, gv_np, gf_np, gp_np = host
+            if stop:
+                # a prior group already aborted/overflowed: drop this
+                # group's mult too — the serial chain never expands past
+                # the aborting group, and the discard() path likewise
+                # contributes nothing
+                return
+            mult_np += np.asarray(mult_g, np.int64)
+            if int(ab) < n_f or bool(ovf_h):
+                # abort (split-brain) or cap_x overflow: nothing reached
+                # the store yet, so run() can report the trace / grow the
+                # budget and redo the level cleanly.  Completed groups'
+                # partials survive the redo — their candidate sets are
+                # budget-independent (see _load_partials)
+                stop["ab"], stop["ovf"] = int(ab), bool(ovf_h)
+                return
+            n_u = int(n_u)
+            gv_c = np.asarray(gv_np[:n_u])
+            gf_c = np.asarray(gf_np[:n_u])
+            gp_c = np.asarray(gp_np[:n_u])
+            hv.append(gv_c)
+            hf.append(gf_c)
+            hp.append(gp_c)
+            if ckdir:
+                self._save_partial(
+                    ckdir, level, gi_, gv_c, gf_c, gp_c,
+                    np.asarray(mult_g, np.int64), n_f,
+                )
+
         for gi in range(n_groups):
+            if stop:
+                break
             if gi in saved:
                 z = saved[gi]
                 hv.append(z["hv"])
@@ -2056,35 +2299,26 @@ class JaxChecker:
                 cat_f = jnp.concatenate(cfs)
                 cat_p = jnp.concatenate(cps)
             n_u_dev, gv, gf, gp = _group_unique(cat_v, cat_f, cat_p)
-            # fetch the FIXED-shape padded buffers and slice host-side:
-            # a device-side gv[:n_u] slice would compile a fresh tiny
-            # program per distinct n_u — one remote compile per group on
-            # a tunneled backend, each a hang/crash opportunity — for a
-            # bandwidth saving of ~6% of the group fetch
-            n_u, ab, ovf_h, mult_g, gv_np, gf_np, gp_np = jax.device_get(
-                (n_u_dev, abort_at, overflow, mult_acc, gv, gf, gp)
+            # submit the FIXED-shape padded buffers to the fetch window
+            # (host-side slicing: a device-side gv[:n_u] slice would
+            # compile a fresh tiny program per distinct n_u — one remote
+            # compile per group on a tunneled backend, each a hang/crash
+            # opportunity — for a bandwidth saving of ~6% of the fetch)
+            win.submit(
+                (n_u_dev, abort_at, overflow, mult_acc, gv, gf, gp),
+                functools.partial(consume, gi),
             )
-            mult_np += np.asarray(mult_g, np.int64)
-            if int(ab) < n_f or bool(ovf_h):
-                # abort (split-brain) or cap_x overflow: nothing reached
-                # the store yet, so run() can report the trace / grow the
-                # budget and redo the level cleanly.  Completed groups'
-                # partials survive the redo — their candidate sets are
-                # budget-independent (see _load_partials)
-                return (0, None, None, int(ab), bool(ovf_h), False, False,
-                        mult_np)
-            n_u = int(n_u)
-            gv_np = np.asarray(gv_np[:n_u])
-            gf_np = np.asarray(gf_np[:n_u])
-            gp_np = np.asarray(gp_np[:n_u])
-            hv.append(gv_np)
-            hf.append(gf_np)
-            hp.append(gp_np)
-            if ckdir:
-                self._save_partial(
-                    ckdir, level, gi, gv_np, gf_np, gp_np,
-                    np.asarray(mult_g, np.int64), n_f,
-                )
+        # ---- window drain: the LEVEL BOUNDARY -------------------------
+        # every group's candidates must be on the host before the level-
+        # global representative choice and the store insert below — a
+        # store insert with the window still open would let half a
+        # level's candidates filter against the other half's inserts
+        if not stop:
+            win.drain()
+        if stop:
+            win.discard()  # complete in-flight fetches, ledger balanced
+            return (0, None, None, stop["ab"], stop["ovf"], False, False,
+                    mult_np)
         # ---- level-global representative choice + visited filter --------
         av = np.concatenate(hv) if hv else np.empty(0, np.uint64)
         af = np.concatenate(hf) if hf else np.empty(0, np.uint64)
@@ -2117,10 +2351,15 @@ class JaxChecker:
                 # meta[7]: fingerprint definition (0 = min-over-P,
                 # 1 = orbit) — a partial's hv/hf are raw fingerprints and
                 # must never be replayed into a run using the other
-                # definition
+                # definition.  meta[8]: the async pipeline's in-flight
+                # window at save time — INFORMATIONAL, never matched on
+                # resume: partials commit in submission order, so a
+                # crash mid-level loses at most this many trailing
+                # groups (the recovery re-expands at most one window)
                 meta=np.asarray(
                     [level, gi, self.chunk, self.cap_x, self.G, self.K,
-                     n_f, int(self.orbit)],
+                     n_f, int(self.orbit),
+                     self.pipeline_window if self.pipeline else 0],
                     np.int64,
                 ),
             ),
@@ -2135,7 +2374,13 @@ class JaxChecker:
         A partial is valid only if its meta matches the in-flight level
         exactly (a cap_x growth redo or a chunk-size change moves every
         group boundary).  Partials from other levels are leftovers of a
-        crash between the delta save and the wipe — delete them."""
+        crash between the delta save and the wipe — delete them.
+        meta[8] (the async pipeline window, when present) is
+        deliberately NOT matched: the window changes only how many
+        trailing groups a crash can lose (consume order == submission
+        order, so saved partials are always a clean prefix-with-holes
+        of completed groups), never a completed group's contents —
+        a resume may freely retune the window like chunk/cap_x."""
         import glob
 
         out = {}
@@ -2182,6 +2427,29 @@ class JaxChecker:
         )
 
     def run(
+        self,
+        max_depth: int | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
+        resume_from: str | None = None,
+    ) -> CheckResult:
+        try:
+            return self._run(
+                max_depth=max_depth, checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every, resume_from=resume_from,
+            )
+        finally:
+            if self._prewarmer is not None:
+                # run over (done, raised, or preempted): give the almost-
+                # finished tail a bounded grace to land in the persistent
+                # compile cache, then drop the queued rest — nothing in
+                # THIS process will use it, and a supervised relaunch
+                # re-forecasts the same plan (a later run() on this
+                # checker builds a fresh prewarmer via _submit_prewarm)
+                self._prewarmer.join(10.0)
+                self._prewarmer.shutdown()
+
+    def _run(
         self,
         max_depth: int | None = None,
         checkpoint_dir: str | None = None,
@@ -2397,6 +2665,15 @@ class JaxChecker:
                             SENT, U64,
                         ),
                     ])
+            if self.prewarm and len(level_sizes) > PRESIZE_MIN_LEVELS:
+                # forecast-driven AOT prewarm: the shape ladder the deep
+                # levels will hit compiles in the background while the
+                # cheap shallow levels run (re-submitted every level —
+                # the Prewarmer dedupes keys, so only a SHARPER forecast
+                # queues fresh programs)
+                self._submit_prewarm(
+                    level_sizes, distinct, max_depth, frontier, visited
+                )
             # --- expand + compact-then-dedup (device), fused level fetch -
             while True:
                 (n_new, new_fps, new_payload, abort_at, overflow, overflow_g,
@@ -2477,7 +2754,13 @@ class JaxChecker:
                 )
             )
             # trace spill: the external-store path already holds the
-            # payloads host-side — no device round-trip there
+            # payloads host-side — no device round-trip there.  The
+            # device path submits its level-tail fetch (trace arrays +
+            # the delta record's fps slice) to the async window instead
+            # of blocking here, so the ~24 B/state tail crosses the host
+            # link WHILE the store merge below runs on the device
+            # (window 0 = the serial fetch-in-place chain).
+            tail = None
             if pay_host is not None:
                 pidx_np = (pay_host // K).astype(np.int64)
                 slot_np = (pay_host % K).astype(np.int64)
@@ -2488,9 +2771,16 @@ class JaxChecker:
                 # ever saw them
                 slot_jdt = jnp.uint16 if K <= 0xFFFF else jnp.uint32
                 slot16 = (new_payload[: n_slices * sl] % K).astype(slot_jdt)
-                pidx_np, slot_np = jax.device_get((pidx32, slot16))
-                pidx_np = pidx_np[:n_new].astype(np.int64)
-                slot_np = slot_np[:n_new].astype(np.int64)
+                tree = [pidx32, slot16]
+                if checkpoint_dir and checkpoint_every:
+                    # the delta record's fps (pow2-quantized device
+                    # slice, host trim — see the checkpoint block)
+                    w_ck = min(new_fps.shape[0],
+                               max(_pow2(n_new), self.chunk))
+                    tree.append(new_fps[:w_ck])
+                tail = graft_pipeline.DeferredFetch(
+                    self.pipeline, tuple(tree)
+                )
             bad_idx = -1
             for si, b in enumerate(bads):
                 if b >= 0:
@@ -2499,7 +2789,6 @@ class JaxChecker:
             frontier = new_frontier
 
             # --- bookkeeping, store merge -------------------------------
-            trace_levels.append((pidx_np, slot_np))
             distinct += n_new
             level_sizes.append(n_new)
             depth += 1
@@ -2531,6 +2820,14 @@ class JaxChecker:
                 visited = _merge_sorted(visited, new_fps[:w])[
                     : max(_cap4(distinct + 1), self._presize_vcap)
                 ]
+            if pay_host is None:
+                # level tail boundary: everything after this needs the
+                # trace arrays host-side (window 0 already fetched them
+                # at submit, serially)
+                h = tail.get()
+                pidx_np = np.asarray(h[0])[:n_new].astype(np.int64)
+                slot_np = np.asarray(h[1])[:n_new].astype(np.int64)
+            trace_levels.append((pidx_np, slot_np))
             n_f = n_new
 
             if self.progress is not None:
@@ -2613,10 +2910,9 @@ class JaxChecker:
                 if fps_host is not None:
                     fps_np = fps_host.astype(np.uint64)
                 else:
-                    w = min(new_fps.shape[0],
-                            max(_pow2(n_new), self.chunk))
+                    # prefetched through the level-tail window above
                     fps_np = np.asarray(
-                        new_fps[:w]
+                        tail.get()[2]
                     )[:n_new].astype(np.uint64)
                 self._save_delta(
                     checkpoint_dir, depth, pidx_np, slot_np, fps_np,

@@ -128,6 +128,40 @@ def horizon_forecast(level_sizes, distinct: int, target_depth: int | None):
     return max(fut), distinct + sum(fut), budget
 
 
+def shape_plan(level_sizes, target_depth: int | None,
+               margin: float = 1.25) -> list[int]:
+    """Margin-inflated per-level row forecast — the AOT prewarm's input.
+
+    One entry per forecast level over the horizon: the new-state rows
+    that level is expected to need, inflated by the same 1.25 margin
+    the presize floors apply.  The engines quantize these through their
+    own capacity functions (pow2 / half-step / chunk-multiple) into the
+    ladder of program shapes worth compiling ahead of time
+    (engine/pipeline.Prewarmer); emitting the raw rows from ONE place
+    keeps the prewarmed ladder and the presize floors from drifting.
+    Empty when there is no usable signal yet.
+    """
+    fut = forecast_new_states(level_sizes, target_depth)[:PRESIZE_HORIZON]
+    return [int(f * margin) + 1 for f in fut]
+
+
+def pow2_ladder(lo: int, hi: int) -> list[int]:
+    """Power-of-two capacities strictly above ``lo`` up to ceil(hi).
+
+    The magnitude steps a growing structure will visit on its way from
+    the current capacity to a forecast peak — each one a program shape
+    the prewarmer can compile before the run needs it."""
+    out: list[int] = []
+    c = pow2ceil(max(1, lo))
+    if c <= lo:
+        c <<= 1
+    top = pow2ceil(max(1, hi))
+    while c <= top:
+        out.append(c)
+        c <<= 1
+    return out
+
+
 def forecast_final_distinct(level_sizes, distinct: int,
                             target_depth: int | None) -> int:
     """Forecast total distinct states at the end of the run."""

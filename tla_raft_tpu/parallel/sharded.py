@@ -56,6 +56,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import resilience
 from ..analysis import sanitize as graft_sanitize
 from ..config import RaftConfig
+from ..engine import pipeline as graft_pipeline
 from ..engine.bfs import _compact_payloads
 from ..engine.invariants import resolve_invariant_kernel
 from ..ops import hashstore
@@ -262,8 +263,25 @@ class ShardedChecker:
         scap: int = 1 << 12,
         scap_max: int = 1 << 22,
         use_hashstore: bool | None = None,
+        pipeline: bool | None = None,
+        pipeline_window: int | None = None,
     ):
         assert exchange in ("all_to_all", "all_gather")
+        # async intra-level pipeline (engine/pipeline.py): the level's
+        # big device->host fetches (routed candidates on the hosted
+        # path, the repacked trace arrays in deep mode, gpidx/slots on
+        # the resident path) go through a bounded AsyncFetchWindow —
+        # copies start the moment their producer is dispatched and
+        # complete through the LEDGERED get only after the remaining
+        # level-tail device work has been dispatched.  Counts are
+        # bit-identical either way; TLA_RAFT_PIPELINE=0 reverts to the
+        # serial fetch-after-dispatch chain.
+        if pipeline is None:
+            pipeline = graft_pipeline.enabled_by_env()
+        if pipeline_window is None:
+            pipeline_window = graft_pipeline.window_from_env()
+        self.pipeline_window = int(pipeline_window)
+        self.pipeline = bool(pipeline) and self.pipeline_window >= 1
         # deep-sweep tier: the frontier itself is sharded 1/D — each
         # device holds its owner share (fp % D) as a list of uniform
         # ``seg_rows``-row segments, the level loop expands segment by
@@ -750,8 +768,9 @@ class ShardedChecker:
         fp_view), first-occurrence per fp_view is the representative
         (min (fp_full, payload) — the deterministic refinement every
         engine of this project pins), then the store's is-new verdict.
-        Returns (verdict [D, D, cap_r] aligned to the recv layout,
-        n_new_total)."""
+        Inputs arrive HOST-SIDE (the caller fetches them through the
+        async window's ledgered path).  Returns (verdict [D, D, cap_r]
+        aligned to the recv layout, n_new_total)."""
         D, cap_r = self.D, self.cap_r
         sent = np.uint64(0xFFFFFFFFFFFFFFFF)
         rv = np.asarray(rv).reshape(D, D * cap_r)
@@ -849,13 +868,23 @@ class ShardedChecker:
             abort=p1.abort, abort_at=p1.abort_at, cand_max=p1.cand_max,
             overflow_x=jnp.zeros((), bool), overflow_v=jnp.zeros((), bool),
         )
+        # the level's big fetch (three D*D*cap_r routed-candidate
+        # buffers) enters the async window NOW, so the copies stream
+        # over the host link while the abort control sync below waits
+        # for the phase-1 programs — and complete through the LEDGERED
+        # get path (the implicit np.asarray conversions this fetch used
+        # to make would trip the sanitizer's transfer guard)
+        routed = graft_pipeline.DeferredFetch(
+            self.pipeline, (p1.rv, p1.rf, p1.rp)
+        )
         if bool(jax.device_get(p1.abort)):
+            routed.discard()  # ledger stays balanced on the abort path
             return SimpleNamespace(
                 n_new_total=jnp.asarray(0, I64), children=None,
                 child_msum=None, n_new_local=None, gpidx=None, slots=None,
                 inv_bad=jnp.asarray(0, I32), inv_bad_at=None, **common,
             )
-        verdict, n_new = self._host_filter(p1.rv, p1.rf, p1.rp)
+        verdict, n_new = self._host_filter(*routed.get())
         vr = jax.device_put(
             jnp.asarray(verdict.reshape(self.D * self.D, self.cap_r)),
             NamedSharding(self.mesh, P("d")),
@@ -1641,12 +1670,15 @@ class ShardedChecker:
             sweep.  The fault site makes the retry path testable."""
             resilience.fault_fire("exchange.fetch")
             if packed_ok:
-                st = np.asarray(jax.device_get(
-                    self._deep_prefix(cap8, qb)(fin.stream)
-                )).reshape(D, qb)
-                nb = np.asarray(jax.device_get(
-                    self._deep_prefix(capnib, qn)(fin.nib)
-                )).reshape(D, qn)
+                st_dev = self._deep_prefix(cap8, qb)(fin.stream)
+                nb_dev = self._deep_prefix(capnib, qn)(fin.nib)
+                if self.pipeline:
+                    # both prefix programs are dispatched; start both
+                    # copies so the streams overlap instead of fetching
+                    # strictly one after the other
+                    graft_pipeline.async_start((st_dev, nb_dev))
+                st = np.asarray(jax.device_get(st_dev)).reshape(D, qb)
+                nb = np.asarray(jax.device_get(nb_dev)).reshape(D, qn)
                 return st, nb, None, D * (qb + qn)
             uqh = np.asarray(jax.device_get(
                 self._deep_prefix(cap_acc, qf)(uq)
@@ -1759,9 +1791,12 @@ class ShardedChecker:
         segs_new, gpo, slo, _nloc = self._deep_rp(Rq, n_out)(
             ch_stack, gp_stack, sl_stack
         )
-        gpo_np, slo_np = jax.device_get((gpo, slo))
-        gpidx_np = np.asarray(gpo_np, np.int64)
-        slots_np = np.asarray(slo_np, np.int64)
+        # the level's trace arrays (its two largest host-bound fetches)
+        # enter the async window here, then the sieve update and the
+        # candidate-peak control fetch below dispatch/run WHILE they
+        # stream — the window drains before the arrays are consumed,
+        # still inside the level
+        tail = graft_pipeline.DeferredFetch(self.pipeline, (gpo, slo))
 
         # --- sieve cache update (level end: the level's own candidates
         # must never sieve each other — exact representative choice) ----
@@ -1784,6 +1819,9 @@ class ShardedChecker:
                 [p.cand_max for p in p1s]
             ))
         )
+        gpo_np, slo_np = tail.get()
+        gpidx_np = np.asarray(gpo_np, np.int64)
+        slots_np = np.asarray(slo_np, np.int64)
         return dict(
             n_new=n_new, segments=list(segs_new), n_f=nl,
             gpidx=gpidx_np, slots=slots_np, mult_slots=mult_np,
@@ -2838,9 +2876,12 @@ class ShardedChecker:
             level_sizes.append(n_new)
             self._cand_hist.append(int(cand_np) / n_new)
             depth += 1
-            gp_np, sl_np = jax.device_get((out.gpidx, out.slots))
-            trace_levels.append(
-                (np.asarray(gp_np, np.int64), np.asarray(sl_np, np.int64))
+            # gpidx/slots are the level's two largest host-bound arrays:
+            # their copies start now and complete through the ledgered
+            # window drain AFTER the store trim / next-frontier device
+            # work below has been dispatched (window 0 = serial fetch)
+            tail = graft_pipeline.DeferredFetch(
+                self.pipeline, (out.gpidx, out.slots)
             )
             if self.host_stores is None:
                 visited = out.visited
@@ -2865,6 +2906,10 @@ class ShardedChecker:
                     visited = jax.device_put(vis, repl)
             frontier, msum = out.children, out.child_msum
             n_f = jax.device_put(out.n_new_local, shard)
+            gp_np, sl_np = tail.get()
+            trace_levels.append(
+                (np.asarray(gp_np, np.int64), np.asarray(sl_np, np.int64))
+            )
             if self.progress is not None:
                 self.progress(
                     dict(

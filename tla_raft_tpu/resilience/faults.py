@@ -27,7 +27,10 @@ committed file AFTER its digest was recorded — the detectable-latent-
 corruption case).  ``manifest.commit`` fires between the manifest's
 tmp write and its rename.  Non-writer sites: ``hashstore.grow`` (the
 Nth slab grow/rehash), ``exchange.fetch`` (the deep-mode host fetch),
-``level.start`` (the top of each BFS level).
+``level.start`` (the top of each BFS level), ``pipeline.window`` (each
+fetch-group submit of the async level pipeline — a kill here lands
+mid-window, with up to a window's worth of groups dispatched but not
+yet consumed/checkpointed).
 
 Determinism: counters are per-site and in-process; the Nth hit is the
 Nth call, full stop.  The no-plan fast path is one attribute load and
@@ -62,6 +65,8 @@ FAULT_SITES = {
     "hashstore.grow": "the Nth visited-slab grow/rehash",
     "exchange.fetch": "deep-mode quantized-prefix host fetch",
     "level.start": "top of a BFS level (both engines)",
+    "pipeline.window": "async-pipeline fetch-group submit (the Nth "
+                       "group entering the in-flight window)",
 }
 
 _ACTIONS = ("kill", "torn", "flip", "fail")
