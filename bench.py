@@ -36,7 +36,8 @@ Env knobs: BENCH_MAX_DEPTH (0 = full sweep), BENCH_CHUNK, BENCH_SERVERS /
 BENCH_VALS / BENCH_MAX_ELECTION (scale dials, BASELINE.md configs 3-5),
 BENCH_GOLD_DEPTH (oracle prefix depth), RAFT_CFG, BENCH_HASHSTORE (0 =
 sort-path A/B), BENCH_PIPELINE (0 = serial-chain A/B) /
-BENCH_PIPELINE_WINDOW (in-flight fetch groups, default 2).
+BENCH_PIPELINE_WINDOW (in-flight fetch groups, default 2), BENCH_MXU
+(0 = legacy per-lane expand A/B).
 """
 
 from __future__ import annotations
@@ -375,6 +376,11 @@ def main():
             int(os.environ["BENCH_PIPELINE_WINDOW"])
             if os.environ.get("BENCH_PIPELINE_WINDOW") else None
         )
+        # BENCH_MXU=0 pins the legacy per-lane guards/materialize — the
+        # A/B lever for the MXU-native expand (docs/PERF.md "MXU-native
+        # expand"); counts are bit-identical either way, so the parity
+        # gates hold in both arms
+        use_mxu = bool(int(os.environ.get("BENCH_MXU", "1")))
     except Exception as e:
         _emit_failure("bench_setup", e)
         return 1
@@ -399,6 +405,7 @@ def main():
                 seg_rows=int(os.environ.get("BENCH_SEG_ROWS", str(1 << 15))),
                 progress=progress, use_hashstore=use_hs,
                 pipeline=use_pipe, pipeline_window=pipe_window,
+                use_mxu=use_mxu,
             )
             res = mchk.run(max_depth=max_depth)
             if mchk.meter.levels:
@@ -409,6 +416,7 @@ def main():
             chk1 = JaxChecker(
                 cfg, chunk=chunk, progress=progress, use_hashstore=use_hs,
                 pipeline=use_pipe, pipeline_window=pipe_window,
+                use_mxu=use_mxu,
             )
             res = chk1.run(max_depth=max_depth)
             pipe_on, pipe_win = chk1.pipeline, chk1.pipeline_window
@@ -513,6 +521,7 @@ def main():
         "hashstore": use_hs,
         "pipeline": pipe_on,
         "pipeline_window": pipe_win if pipe_on else 0,
+        "mxu": use_mxu,
     }
     if full_golden is not None:
         out["golden_full"] = {
@@ -560,6 +569,7 @@ def main():
             "hashstore": out["hashstore"],
             "pipeline": out["pipeline"],
             "pipeline_window": out["pipeline_window"],
+            "mxu": out["mxu"],
         }
         for k in ("mesh", "mesh_deep", "peak_dev_rows", "exchange"):
             if k in out:

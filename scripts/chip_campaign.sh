@@ -51,6 +51,12 @@ for s in "${steps[@]}"; do
       run_bench docs/BENCH_S3_r05.json ;;
     s3big) # bigger chunk variant
       run_bench docs/BENCH_S3_c16k_r05.json BENCH_CHUNK=16384 ;;
+    s3legacy) # legacy per-lane expand A/B arm for the MXU-native expand
+           # (docs/PERF.md "MXU-native expand"): identical s3 run with
+           # BENCH_MXU=0 — counts must be bit-identical; the wall-clock
+           # delta is the guard-matmul + gather-free-materialize win on
+           # real silicon (the gather cliff does not exist on CPU)
+      run_bench docs/BENCH_S3_LEGACY_r11.json BENCH_MXU=0 ;;
     s5)    # scale config 3 (warm steady-state — run s5 twice; the
            # second run reads the persistent compile cache).  Gold depth 9
            # as in r3: the Python oracle's S! fold makes depth 12 a ~45-min

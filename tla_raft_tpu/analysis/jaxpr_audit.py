@@ -21,10 +21,20 @@ jaxpr on a tiny reference config and walked recursively:
   the silent-regression class that erases kernel wins one primitive at
   a time.
 
+* **GL010 — gather/scatter budget** — the hot expand kernels (guards,
+  materialize, dense expand, and their retained legacy A/B twins) each
+  carry a *budget* of data-indexed ``gather`` and ``scatter*``
+  primitives equal to their ledgered count.  Exceeding the budget is a
+  HARD failure even across jax versions: the budget is semantic (the
+  MXU-native expand exists precisely to kill this primitive class —
+  the launch-cost cliff of docs/PERF.md), not a lowering artifact.
+  Shrinking below budget only trips the ordinary ledger diff, which
+  says "regenerate and bank the win".
+
 The golden ledger records the jax version it was generated under; when
 the running version differs, the diff degrades to a warning (jaxpr
 lowering legitimately drifts across jax releases) while the hard
-failures still apply.  Regenerate with
+failures and the GL010 budget still apply.  Regenerate with
 ``python -m tla_raft_tpu.analysis --write-ledger`` and review the diff.
 """
 
@@ -45,6 +55,24 @@ COLLECTIVE_PRIMITIVES = {
 }
 
 _NARROW_KEY = "convert_element_type[narrow64]"
+
+# GL010: the kernels under the data-indexed gather/scatter budget —
+# the per-level expand hot path (both MXU and legacy A/B variants)
+GL010_KERNELS = (
+    "successor.expand_guards",
+    "successor.materialize",
+    "successor.expand_guards_legacy",
+    "successor.materialize_legacy",
+    "dense.expand",
+)
+
+
+def gather_scatter_count(prims: dict) -> int:
+    """Data-indexed gather + scatter-class primitive count of a ledger
+    histogram (the GL010 budget metric)."""
+    return prims.get("gather", 0) + sum(
+        v for k, v in prims.items() if k.startswith("scatter")
+    )
 
 
 def _tiny_cfg():
@@ -75,7 +103,10 @@ def kernel_registry():
     from ..parallel.exchange import pack_fp_deltas
 
     cfg = _tiny_cfg()
-    kern = get_kernel(cfg)
+    # mxu pinned ON so the audited successor.* entries are the shipped
+    # default regardless of the caller's TLA_RAFT_MXU; the legacy A/B
+    # kernels are registered from the same kernel's *_legacy bindings
+    kern = get_kernel(cfg, mxu=True)
     fpr = kern.fpr
     st = init_batch(cfg, 8)
     msum = fpr.msg_hash(st.msgs)
@@ -86,10 +117,20 @@ def kernel_registry():
     pays = jnp.zeros((256,), jnp.int64)
 
     return {
+        # the MXU-native hot path (ops/mxu_expand.py, the default):
+        # guards = coefficient matmul + message terms, materialize =
+        # select-matrix products — both at a ZERO gather/scatter budget
         "successor.expand_guards":
             lambda: jax.make_jaxpr(kern.expand_guards)(st),
         "successor.materialize":
             lambda: jax.make_jaxpr(kern.materialize)(st, slots),
+        # the legacy per-lane kernels, retained for A/B: their ledger
+        # entries pin the OLD gather/scatter budget so the comparison
+        # baseline cannot silently drift either
+        "successor.expand_guards_legacy":
+            lambda: jax.make_jaxpr(kern.expand_guards_legacy)(st),
+        "successor.materialize_legacy":
+            lambda: jax.make_jaxpr(kern.materialize_legacy)(st, slots),
         "dense.expand":
             lambda: jax.make_jaxpr(kern.expand)(st, msum),
         "fingerprint.state_fingerprints":
@@ -232,6 +273,24 @@ def audit(golden=_DEFAULT_GOLDEN) -> tuple[list[str], list[str]]:
             "golden_ledger.json"
         )
         return failures, warnings
+
+    # GL010: gather/scatter budget for the hot expand kernels — a HARD
+    # failure regardless of jax version (the budget is semantic; see
+    # the module docstring).  Budgets come from the committed ledger.
+    for name in GL010_KERNELS:
+        entry, gold = current.get(name), golden.get(name)
+        if entry is None or gold is None:
+            continue  # missing-kernel drift is reported below
+        cur_gs = gather_scatter_count(entry["primitives"])
+        budget = gather_scatter_count(gold["primitives"])
+        if cur_gs > budget:
+            failures.append(
+                f"[GL010] {name}: data-indexed gather/scatter count "
+                f"{cur_gs} exceeds the ledgered budget {budget} — the "
+                "expand hot path regressed onto the launch-cost cliff "
+                "(docs/PERF.md); keep the kernel on the MXU-factored "
+                "formulation or justify a new budget with --write-ledger"
+            )
 
     same_version = golden.get("_meta", {}).get("jax") == jax.__version__
     sink = failures if same_version else warnings

@@ -126,6 +126,16 @@ def _has_checkpoints(ckdir: str) -> bool:
     )
 
 
+def _mxu_arg(args):
+    """--mxu-expand / --no-mxu-expand -> the checkers' use_mxu argument
+    (None = the TLA_RAFT_MXU env default, which is ON)."""
+    if args.no_mxu_expand:
+        return False
+    if args.mxu_expand is None:
+        return None
+    return bool(args.mxu_expand)
+
+
 def _supervise(args, raw_argv) -> int:
     """Supervisor mode: run the check as a child process, relaunching a
     crashed/preempted child from its own checkpoint directory up to N
@@ -273,6 +283,14 @@ def main(argv=None) -> int:
                         "for tunneled backends; env: TLA_RAFT_PREWARM; "
                         "single-device engine only — ignored with "
                         "--mesh)")
+    p.add_argument("--mxu-expand", type=int, choices=(0, 1), default=None,
+                   help="MXU-native expand: guard coefficient matmul + "
+                        "gather-free materialize (ops/mxu_expand.py). "
+                        "Default on; 0 reverts to the legacy per-lane "
+                        "kernels (A/B — counts are bit-identical). "
+                        "env: TLA_RAFT_MXU")
+    p.add_argument("--no-mxu-expand", action="store_true",
+                   help="shorthand for --mxu-expand 0")
     p.add_argument("--no-hashstore", action="store_true",
                    help="revert to the sort-based visited path (lexsort "
                         "+ searchsorted + sorted merge) instead of the "
@@ -349,6 +367,7 @@ def main(argv=None) -> int:
         print(f"Spec {spec_path}: structure matches compiled semantics.", file=out)
 
     sanitizer = None
+    chk = None  # the engine instance (None on the oracle backend)
     if args.backend == "oracle":
         from .oracle import OracleChecker
 
@@ -431,6 +450,7 @@ def main(argv=None) -> int:
                 use_hashstore=not args.no_hashstore,
                 pipeline=False if args.no_pipeline else None,
                 pipeline_window=args.pipeline_window,
+                use_mxu=_mxu_arg(args),
             )
             try:
                 with sanctx:
@@ -465,17 +485,19 @@ def main(argv=None) -> int:
         else:
             try:
                 with sanctx:
-                    res = JaxChecker(
+                    chk = JaxChecker(
                         cfg, chunk=args.chunk, progress=progress,
                         host_store=host_store, canon=args.canon,
                         use_hashstore=not args.no_hashstore,
                         pipeline=False if args.no_pipeline else None,
                         pipeline_window=args.pipeline_window,
+                        use_mxu=_mxu_arg(args),
                         prewarm=(
                             None if args.prewarm is None
                             else bool(args.prewarm)
                         ),
-                    ).run(
+                    )
+                    res = chk.run(
                         max_depth=args.max_depth,
                         checkpoint_dir=args.checkpoint_dir,
                         checkpoint_every=args.checkpoint_every,
@@ -525,6 +547,7 @@ def main(argv=None) -> int:
                     # the crash-matrix tests diff these against an
                     # uninterrupted run's, level by level
                     level_sizes=list(res.level_sizes),
+                    mxu=getattr(chk, "use_mxu", None),
                     seconds=round(dt, 3),
                 )
             ),

@@ -457,6 +457,7 @@ class JaxChecker:
         pipeline: bool | None = None,
         pipeline_window: int | None = None,
         prewarm: bool | None = None,
+        use_mxu: bool | None = None,
     ):
         # canon="late": expand computes guards only; the compacted
         # candidates are materialized and fingerprinted with the full-state
@@ -468,7 +469,13 @@ class JaxChecker:
         assert canon in ("late", "expand")
         self.canon = canon
         self.cfg = cfg
-        self.kern: SuccessorKernel = get_kernel(cfg)
+        # MXU-native expand (ops/mxu_expand.py): guards as the coefficient
+        # matmul, materialize as gather-free select-matrix products.
+        # Default ON; TLA_RAFT_MXU=0 / --no-mxu-expand / use_mxu=False
+        # reverts to the legacy per-lane kernels — counts are
+        # bit-identical either way (the MXU parity suite diffs the two).
+        self.kern: SuccessorKernel = get_kernel(cfg, mxu=use_mxu)
+        self.use_mxu = self.kern.use_mxu
         self.fpr = self.kern.fpr
         self.K = self.kern.K
         self.uni_words = self.kern.uni.n_words
@@ -1183,8 +1190,10 @@ class JaxChecker:
                     caps.add(self._frontier_cap(r))
             for c in sorted(caps):
                 fs = self._frontier_struct(frontier, c)
+                # the span program traces the kernel's guards/materialize,
+                # so its identity includes the MXU-vs-legacy selection
                 plan.append((
-                    ("span", c),
+                    ("span", c, self.use_mxu),
                     lambda fs=fs: self._expand_span.lower(
                         fs, s_i64, s_i64, s_i64
                     ).compile(),

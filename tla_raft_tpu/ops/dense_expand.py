@@ -179,6 +179,152 @@ class DenseExpand:
         g = self.fpr.msg_coef_eff(id_p)
         return jnp.where(live[..., None, None] != 0, g, U32(0))
 
+    # ---- message-side guard terms (the MXU split) -----------------------
+
+    def msg_guard_parts(self, st):
+        """(msg_ok bool[B,K], mult i32[B,K], abort bool[B]).
+
+        The message-dependent half of every guard — existence/count
+        reductions over the mixed-radix blocks, including the terms
+        whose digits are data-indexed (term/prevLogTerm one-hots) —
+        mirrored term for term from ``__call__``.  The static
+        (message-independent) half lives in ops/mxu_expand.py as the
+        guard coefficient matmul; the two factors partition exactly the
+        conjuncts of each scalar guard in ops/successor.py, so
+        ``static & msg`` is bit-identical to the fused ``valid``.
+        Families with no message guard emit all-true / mult 1.
+        """
+        cfg, uni = self.cfg, self.uni
+        S, T, L, V, E, NP = self.S, self.T, self.L, self.V, self.E, self.NP
+        B = st.voted_for.shape[0]
+        i32 = lambda x: x.astype(I32)
+        role = i32(st.role)
+        ct = i32(st.current_term)
+        ll = i32(st.log_len)
+        lt = i32(st.log_term)
+        ci = i32(st.commit_index)
+
+        bits = self.fpr.unpack_bits(st.msgs).astype(I32)
+        vq = bits[:, : uni.vp_off].reshape(B, NP, T, L, T)
+        vp = bits[:, uni.vp_off : uni.aq_off].reshape(B, NP, T)
+        aq = bits[:, uni.aq_off : uni.ap_off].reshape(
+            B, NP, T, L, T + 1, E, L
+        )
+        NPLI = uni.ap_npli
+        legacy_ae = "legacy-append" in cfg.mutations
+        ap = bits[:, uni.ap_off :].reshape(B, NP, T, NPLI, 2)
+
+        vq_r = vq.sum((3, 4), dtype=I32)
+        aq_r = aq.sum((3, 4, 5, 6), dtype=I32)
+        ap_r = ap.sum((3, 4), dtype=I32)
+        to_cnt = jnp.einsum("bpt,dp->bdt", vq_r + vp + aq_r + ap_r, self.SELD)
+        aq_to_cnt = jnp.einsum("bpt,dp->bdt", aq_r, self.SELD)
+        AQR = aq.sum((5, 6), dtype=I32)
+        ap0, ap1 = ap[..., 0], ap[..., 1]
+
+        oh_ct = _oh(jnp.clip(ct - 1, 0, T - 1), T)
+        has_term = ct >= 1
+        oh_ll_pos = _oh(jnp.clip(ll - 1, 0, L - 1), L)
+        llt_val = (oh_ll_pos * lt).sum(-1, dtype=I32)
+        tcur1 = jnp.clip(ct, 1, T)
+        pli_ax = jnp.arange(1, L + 1, dtype=I32)
+        true_ = lambda *sh: jnp.ones((B, *sh), bool)
+        one_ = lambda *sh: jnp.ones((B, *sh), I32)
+
+        ok_parts, mult_parts = [], []
+
+        def emit(ok, mult):
+            ok_parts.append(ok.reshape(B, -1))
+            mult_parts.append(mult.reshape(B, -1))
+
+        # F0 BecomeCandidate: no message guard
+        emit(true_(S), one_(S))
+        # F1 UpdateTerm (a): any message to s at term t
+        emit(to_cnt > 0, to_cnt)
+        # F2 UpdateTerm (b) + the split-brain Assert (Raft.tla:185)
+        cnt2 = jnp.einsum("bdt,bdt->bd", aq_to_cnt, oh_ct)
+        has2 = has_term & (cnt2 > 0)
+        if "become-follower" in cfg.mutations:
+            abort = jnp.zeros((B,), bool)
+        else:
+            abort = (has2 & (role == LEADER)).any(1)
+        emit(cnt2 > 0, cnt2)
+        # F3 ResponseVote: up-to-date VoteReq present, grant not re-sent
+        UP = jnp.einsum("bptlk,klmj->bptmj", vq, self.QUAL)
+        oh_myllt = _oh(jnp.clip(llt_val, 0, T), T + 1)
+        qual_cnt = jnp.einsum(
+            "bptmj,csp,bst,bsm,bsj->bsc",
+            UP, self.SELP, oh_ct, oh_myllt, oh_ll_pos,
+        )
+        grant_bit = jnp.einsum("bpt,scp,bst->bsc", vp, self.SELP, oh_ct)
+        emit((qual_cnt > 0) & (grant_bit == 0), qual_cnt)
+        # F4 BecomeLeader: the vote-count threshold (Raft.tla:160-164)
+        votes = jnp.einsum("bpt,sp,bst->bs", vp, self.SELD, oh_ct)
+        emit(votes + 1 >= cfg.majority, one_(S))
+        # F5 ClientReq: no message guard
+        emit(true_(S, V), one_(S, V))
+        # F6 LeaderAppendEntry: the exact request not already in flight
+        ni = i32(st.next_index)
+        lv = i32(st.log_val)
+        pli6 = jnp.clip(ni - 1, 1, L)
+        prev_oh = _oh(jnp.clip(ni - 2, 0, L - 1), L)
+        plt6 = jnp.clip(jnp.einsum("bsdl,bsl->bsd", prev_oh, lt), 0, T)
+        has_e = ni <= ll[:, :, None]
+        epos_oh = _oh(jnp.clip(ni - 1, 0, L - 1), L)
+        et6 = jnp.clip(jnp.einsum("bsdl,bsl->bsd", epos_oh, lt), 1, T)
+        ev6 = jnp.clip(jnp.einsum("bsdl,bsl->bsd", epos_oh, lv), 1, V)
+        ecode6 = jnp.where(has_e, 1 + (et6 - 1) * V + (ev6 - 1), 0)
+        lc6 = jnp.clip(ci, 1, L)[:, :, None]
+        present6 = jnp.einsum(
+            "bqtlmeh,sdq,bsdt,bsdl,bsdm,bsde,bsdh->bsd",
+            aq, self.SELP,
+            _oh(jnp.broadcast_to(tcur1[:, :, None], (B, S, S)) - 1, T),
+            _oh(pli6 - 1, L), _oh(plt6, T + 1), _oh(ecode6, E),
+            _oh(jnp.broadcast_to(lc6, (B, S, S)) - 1, L),
+        )
+        emit(present6 == 0, one_(S, S))
+        # F7 FollowerAcceptEntry: the exact request present (+ the dead
+        # FollowerAppendEntry's resp/commit-advance gate under mutation)
+        plt7 = jnp.clip(lt, 0, T)
+        oh_plt7 = _oh(plt7, T + 1)
+        present7 = jnp.einsum(
+            "bqtlmeh,csq,bst,bslm->bscleh", aq, self.SELP, oh_ct, oh_plt7
+        )
+        ok7 = present7 > 0
+        if legacy_ae:
+            oh_pi = _oh(self.PI - uni.ap_pli_min, NPLI)
+            resp_present7 = jnp.einsum(
+                "bqtj,scq,bst,lej->bscle", ap1, self.SELP, oh_ct, oh_pi
+            )
+            ci_adv = self.MINLC[None, None] > ci[:, :, None, None, None]
+            ok7 = ok7 & (
+                (resp_present7[:, :, :, :, :, None] == 0) | ci_adv[:, :, None]
+            )
+        emit(ok7, one_(S, S, L, E, L))
+        # F8 FollowerRejectEntry: mismatching blocks present, reject unsent
+        log_match = pli_ax[None, None, :] <= ll[:, :, None]
+        tot8 = jnp.einsum("bqtlm,csq,bst->bscl", AQR, self.SELP, oh_ct)
+        match8 = jnp.einsum(
+            "bqtlm,csq,bst,bslm->bscl", AQR, self.SELP, oh_ct, oh_plt7
+        )
+        cnt8 = tot8 - jnp.where(log_match[:, :, None, :], match8, 0)
+        ap0_rej = ap0 if uni.ap_pli_min == 1 else ap0[:, :, :, :L]
+        rej_bit = jnp.einsum("bqtl,scq,bst->bscl", ap0_rej, self.SELP, oh_ct)
+        emit((cnt8 > 0) & (rej_bit == 0), cnt8)
+        # F9 HandleAppendResp: the response bit present
+        ap9 = ap if uni.ap_pli_min == 1 else ap[:, :, :, 1:]
+        bit9 = jnp.einsum("bqtlx,csq,bst->bsclx", ap9, self.SELP, oh_ct)
+        emit(bit9 > 0, one_(S, S, L, 2))
+        # F10 LeaderCanCommit / F11 Restart: no message guard
+        emit(true_(S), one_(S))
+        emit(true_(S), one_(S))
+
+        return (
+            jnp.concatenate(ok_parts, axis=1),
+            jnp.concatenate(mult_parts, axis=1),
+            abort,
+        )
+
     # ---- the expand ------------------------------------------------------
 
     def __call__(self, st, msum, want_fp: bool = True):

@@ -67,6 +67,7 @@ from ..models.raft import RaftState
 from .dense_expand import DenseExpand
 from .fingerprint import Fingerprinter, get_fingerprinter
 from .msg_universe import get_universe
+from .mxu_expand import MXUExpand, mxu_enabled_by_env
 
 I32 = jnp.int32
 U8 = jnp.uint8
@@ -94,6 +95,13 @@ class GuardTables:
     that match a (type, src, dst, term, ...) pattern; guards evaluate as
     ``msgs & row`` followed by any/popcount.  Index conventions: servers
     and terms are offset to 0-based rows (term t -> row t-1).
+
+    The MXU expand extends this table family with per-action guard/update
+    *coefficient* tables (ops/mxu_expand.MXUTables, attached as ``.mxu``
+    when the MXU path is selected): the 0/1 guard coefficient matrix +
+    threshold that turns the static guard conjunctions into one
+    [lanes, feat] x [feat, actions] matmul, and the per-slot update
+    constant block behind the gather-free materialize.
     """
 
     def __init__(self, cfg: RaftConfig):
@@ -232,9 +240,22 @@ def _popcount(msgs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
 
 
 class SuccessorKernel:
-    """Compiled fan-out for one RaftConfig (SURVEY.md §7.2 step 2)."""
+    """Compiled fan-out for one RaftConfig (SURVEY.md §7.2 step 2).
 
-    def __init__(self, cfg: RaftConfig, fpr: Fingerprinter | None = None):
+    ``mxu`` selects the MXU-factored hot path (ops/mxu_expand.py):
+    ``expand_guards`` becomes the guard coefficient matmul + the dense
+    message terms, and ``materialize``/``materialize_added`` the
+    gather-free select-matrix formulation.  Default from TLA_RAFT_MXU
+    (on); the legacy kernels stay jitted as ``*_legacy`` for A/B —
+    both are bit-identical on every input (tests/test_mxu_expand.py).
+    """
+
+    def __init__(
+        self,
+        cfg: RaftConfig,
+        fpr: Fingerprinter | None = None,
+        mxu: bool | None = None,
+    ):
         self.cfg = cfg
         self.uni = get_universe(cfg)
         self.fpr = fpr or get_fingerprinter(cfg)
@@ -291,9 +312,25 @@ class SuccessorKernel:
         self.dense = DenseExpand(cfg, self.uni, self.fpr)
         self.expand = jax.jit(self._expand_dense)
         self.expand_reference = jax.jit(self._expand)
-        self.expand_guards = jax.jit(self._expand_guards)
-        self.materialize = jax.jit(self._materialize)
-        self.materialize_added = jax.jit(self._materialize_added)
+        # legacy guards/materialize kernels, always jitted: the A/B
+        # reference the MXU parity gates and the probe microbench diff
+        self.expand_guards_legacy = jax.jit(self._expand_guards)
+        self.materialize_legacy = jax.jit(self._materialize)
+        self.materialize_added_legacy = jax.jit(self._materialize_added)
+        if mxu is None:
+            mxu = mxu_enabled_by_env()
+        self.use_mxu = bool(mxu)
+        self.mxu = None
+        if self.use_mxu:
+            self.mxu = MXUExpand(self)
+            self.tables.mxu = self.mxu.tables  # GuardTables extension
+            self.expand_guards = jax.jit(self._expand_guards_mxu)
+            self.materialize = jax.jit(self.mxu.materialize)
+            self.materialize_added = jax.jit(self.mxu.materialize_added)
+        else:
+            self.expand_guards = self.expand_guards_legacy
+            self.materialize = self.materialize_legacy
+            self.materialize_added = self.materialize_added_legacy
 
     def _expand_dense(self, st: RaftState, msum: jnp.ndarray) -> Expansion:
         valid, mult, fpv, fpf, abort = self.dense(st, msum)
@@ -306,6 +343,14 @@ class SuccessorKernel:
         late-canonicalization path (engine/bfs.py) fingerprints only the
         compacted candidates from their materialized states."""
         valid, mult, _fpv, _fpf, abort = self.dense(st, None, want_fp=False)
+        return valid, mult & jnp.where(valid, -1, 0), abort
+
+    def _expand_guards_mxu(self, st: RaftState):
+        """MXU guards-only pass 1: the static guard conjunctions as ONE
+        [lanes, feat] x [feat, actions] coefficient matmul + threshold,
+        AND'd with the message-side dense terms — same contract and
+        bit-identical outputs as ``_expand_guards``."""
+        valid, mult, abort = self.mxu.guards(st)
         return valid, mult & jnp.where(valid, -1, 0), abort
 
     # -- scalar action transcriptions -------------------------------------
@@ -698,5 +743,14 @@ class SuccessorKernel:
 
 
 @functools.lru_cache(maxsize=8)
-def get_kernel(cfg: RaftConfig) -> SuccessorKernel:
-    return SuccessorKernel(cfg)
+def _get_kernel_cached(cfg: RaftConfig, mxu: bool) -> SuccessorKernel:
+    return SuccessorKernel(cfg, mxu=mxu)
+
+
+def get_kernel(cfg: RaftConfig, mxu: bool | None = None) -> SuccessorKernel:
+    """Kernel cache, keyed (cfg, mxu).  ``mxu=None`` resolves the env
+    default HERE (not inside the cached call) so tests flipping
+    TLA_RAFT_MXU never see a stale kernel."""
+    if mxu is None:
+        mxu = mxu_enabled_by_env()
+    return _get_kernel_cached(cfg, bool(mxu))

@@ -936,3 +936,29 @@ class OracleChecker:
             sid = parent
         out.reverse()
         return out
+
+
+def collect_reachable(cfg: RaftConfig, n: int, tile: bool = False) -> list:
+    """The first ``n`` reachable states in BFS order (aborting branches
+    skipped) — the shared corpus builder for the kernel differential
+    tests and the expand microbenches.  ``tile=True`` repeats the walk
+    cyclically when the reachable space is smaller than ``n``."""
+    seen = {init_state(cfg)}
+    order = [init_state(cfg)]
+    frontier = [init_state(cfg)]
+    while frontier and len(order) < n:
+        nxt = []
+        for st in frontier:
+            try:
+                succs = successors(cfg, st)
+            except SplitBrainAbort:
+                continue
+            for _a, _s, _d, ch in succs:
+                if ch not in seen:
+                    seen.add(ch)
+                    order.append(ch)
+                    nxt.append(ch)
+        frontier = nxt
+    if tile and order and len(order) < n:
+        order = (order * (-(-n // len(order))))
+    return order[:n]

@@ -265,6 +265,7 @@ class ShardedChecker:
         use_hashstore: bool | None = None,
         pipeline: bool | None = None,
         pipeline_window: int | None = None,
+        use_mxu: bool | None = None,
     ):
         assert exchange in ("all_to_all", "all_gather")
         # async intra-level pipeline (engine/pipeline.py): the level's
@@ -347,7 +348,11 @@ class ShardedChecker:
         self.canon = canon
         self.cfg = cfg
         self.mesh = mesh
-        self.kern = get_kernel(cfg)
+        # MXU-native expand (ops/mxu_expand.py): both mesh paths route
+        # their guards/materialize through the kernel, so the selection
+        # happens here once; TLA_RAFT_MXU=0 / --no-mxu-expand reverts
+        self.kern = get_kernel(cfg, mxu=use_mxu)
+        self.use_mxu = self.kern.use_mxu
         self.fpr = self.kern.fpr
         self.K = self.kern.K
         self.D = mesh.devices.size
