@@ -37,7 +37,8 @@ BENCH_VALS / BENCH_MAX_ELECTION (scale dials, BASELINE.md configs 3-5),
 BENCH_GOLD_DEPTH (oracle prefix depth), RAFT_CFG, BENCH_HASHSTORE (0 =
 sort-path A/B), BENCH_PIPELINE (0 = serial-chain A/B) /
 BENCH_PIPELINE_WINDOW (in-flight fetch groups, default 2), BENCH_MXU
-(0 = legacy per-lane expand A/B).
+(0 = legacy per-lane expand A/B), BENCH_SERVICE (1 = the sweep-service
+jobs/hour A/B on the synthetic queue instead — see _bench_service).
 """
 
 from __future__ import annotations
@@ -201,6 +202,178 @@ def _best_window_rate(levels, fallback, max_level=None):
     return best
 
 
+def _bench_service_arm(jax) -> int:
+    """One A/B arm, in its own process (BENCH_SERVICE_ARM=batched|
+    sequential): builds its queue, drains it, prints one JSON line.
+
+    Process isolation is the point: each arm gets a FRESH persistent
+    compile cache (TLA_RAFT_COMPILE_CACHE, set by the parent) and a
+    cold in-process kernel/jit cache, so neither arm rides programs
+    the other (or an earlier bench run) already paid to compile."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "scripts")
+    )
+    import queue_synth
+
+    from tla_raft_tpu.service.daemon import Scheduler
+    from tla_raft_tpu.service.queue import JobQueue
+
+    arm = os.environ["BENCH_SERVICE_ARM"]
+    n_jobs = int(os.environ.get("BENCH_SERVICE_JOBS", "40"))
+    mr_width = int(os.environ.get("BENCH_SERVICE_MR_WIDTH", "16"))
+    seed = int(os.environ.get("BENCH_SERVICE_SEED", "1"))
+    chunk = int(os.environ.get("BENCH_SERVICE_CHUNK", "64"))
+    jobs = queue_synth.synth_jobs(n_jobs, seed, mr_width, chunk)
+    root = os.path.join(os.environ["BENCH_SERVICE_BASE"], arm)
+    q = JobQueue(root)
+    jids = [
+        q.submit(cfg, max_depth=cap, options=opt)
+        for cfg, cap, opt in jobs
+    ]
+    sched = Scheduler(q, batch=(arm == "batched"))
+    t0 = time.monotonic()
+    stats = sched.run_once()
+    wall = time.monotonic() - t0
+    print(json.dumps(dict(
+        service_arm=arm, wall_s=wall, stats=stats,
+        results=[q.load_result(j) for j in jids],
+        device=str(jax.devices()[0]),
+    )))
+    return 0
+
+
+def _bench_service(jax) -> int:
+    """BENCH_SERVICE=1: the sweep-service jobs/hour A/B.
+
+    Builds the synthetic sweep queue (scripts/queue_synth.py) twice and
+    drains it through the scheduler both ways — config-batched and
+    sequential, each arm a fresh subprocess with a fresh compile cache
+    (see _bench_service_arm) — then gates on per-job summary parity
+    between the arms (distinct/generated/depth/level_sizes must be
+    bit-identical) before reporting jobs/hour and configs-per-dispatch.
+    Knobs: BENCH_SERVICE_JOBS (default 40 — 10 MaxRestart values per
+    base key, so every bucket demonstrates >= 10 configs on one
+    compiled program ladder), BENCH_SERVICE_MR_WIDTH,
+    BENCH_SERVICE_SEED, BENCH_SERVICE_CHUNK, BENCH_SERVICE_ROOT (keep
+    the queue dirs)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    if os.environ.get("BENCH_SERVICE_ARM"):
+        return _bench_service_arm(jax)
+
+    try:
+        n_jobs = int(os.environ.get("BENCH_SERVICE_JOBS", "40"))
+        keep_root = os.environ.get("BENCH_SERVICE_ROOT")
+        base = keep_root or tempfile.mkdtemp(prefix="bench_service_")
+    except Exception as e:
+        _emit_failure("bench_setup", e, unit="jobs_per_hour")
+        return 1
+
+    def run_arm(name: str):
+        env = dict(
+            os.environ,
+            BENCH_SERVICE_ARM=name,
+            BENCH_SERVICE_BASE=base,
+            TLA_RAFT_COMPILE_CACHE=os.path.join(base, f"cache_{name}"),
+        )
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=7200,
+        )
+        sys.stderr.write(p.stderr[-4000:])
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"{name} arm exited {p.returncode}: {p.stdout[-500:]}"
+            )
+        doc = json.loads(
+            [ln for ln in p.stdout.splitlines()
+             if ln.startswith("{")][-1]
+        )
+        return doc["stats"], doc["wall_s"], doc["results"], doc
+
+    try:
+        b_stats, b_wall, b_res, b_doc = run_arm("batched")
+        s_stats, s_wall, s_res, _s_doc = run_arm("sequential")
+    except Exception as e:
+        _emit_failure("service_run", e, unit="jobs_per_hour")
+        return 1
+
+    # parity gate: per-job summaries bit-identical between the arms
+    keys = ("ok", "distinct", "generated", "depth", "level_sizes")
+    parity = True
+    mismatch = None
+    for i, (a, b) in enumerate(zip(b_res, s_res)):
+        if a is None or b is None or any(a[k] != b[k] for k in keys):
+            parity = False
+            mismatch = dict(
+                job=i,
+                batched=None if a is None else {k: a[k] for k in keys},
+                sequential=None if b is None else {k: b[k] for k in keys},
+            )
+            break
+
+    disp = max(b_stats["dispatches"], 1)
+    out = {
+        "metric": f"raft_sweep_service_{n_jobs}jobs",
+        "value": round(n_jobs / b_wall * 3600.0, 1),
+        "unit": "jobs_per_hour",
+        "vs_baseline": round(s_wall / b_wall, 2),
+        "parity": parity,
+        "ok": parity and all(r is not None for r in b_res),
+        "jobs": n_jobs,
+        "wall_s": round(b_wall, 2),
+        "sequential_jobs_per_hour": round(n_jobs / s_wall * 3600.0, 1),
+        "sequential_wall_s": round(s_wall, 2),
+        "buckets": b_stats["buckets"],
+        "max_bucket_configs": b_stats["max_bucket"],
+        "configs_per_dispatch": round(
+            b_stats["config_dispatch_weight"] / disp, 2
+        ),
+        "batched_dispatches": b_stats["dispatches"],
+        "programs_traced": b_stats["programs"],
+        "device": b_doc["device"],
+        "config": (
+            "synthetic queue (seed "
+            f"{os.environ.get('BENCH_SERVICE_SEED', '1')}, mr_width "
+            f"{os.environ.get('BENCH_SERVICE_MR_WIDTH', '16')}, chunk "
+            f"{os.environ.get('BENCH_SERVICE_CHUNK', '64')}, "
+            "cold per-arm compile caches)"
+        ),
+    }
+    if mismatch is not None:
+        out["error"] = mismatch
+    print(json.dumps(out))
+    bench_out = os.environ.get("BENCH_OUT")
+    if bench_out:
+        record = {
+            "schema": "tla-raft-bench/1",
+            "metric": out["metric"],
+            "config": out["config"],
+            "jobs_per_hour": out["value"],
+            "unit": out["unit"],
+            "parity": out["parity"],
+            "ok": out["ok"],
+            "wall_s": out["wall_s"],
+            "vs_baseline": out["vs_baseline"],
+            "sequential_jobs_per_hour": out["sequential_jobs_per_hour"],
+            "buckets": out["buckets"],
+            "max_bucket_configs": out["max_bucket_configs"],
+            "configs_per_dispatch": out["configs_per_dispatch"],
+            "programs_traced": out["programs_traced"],
+            "device": out["device"],
+        }
+        tmp = bench_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1)
+        os.replace(tmp, bench_out)
+    if not keep_root:
+        shutil.rmtree(base, ignore_errors=True)
+    return 0 if parity else 1
+
+
 def main():
     os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
     # mesh benches on a virtual CPU mesh need the device-count XLA flag
@@ -212,6 +385,11 @@ def main():
 
         ensure_virtual_cpu_mesh(mesh_n)
     jax = _init_jax_or_reexec()
+
+    # BENCH_SERVICE=1: the sweep-service jobs/hour A/B instead of the
+    # single-sweep throughput bench (docs/SERVICE.md)
+    if int(os.environ.get("BENCH_SERVICE", "0")):
+        return _bench_service(jax)
 
     # every stage before the engine run is wrapped so an exception
     # anywhere still yields a parseable ok:false line (ADVICE r4 #2:

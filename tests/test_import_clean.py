@@ -23,6 +23,10 @@ MODULES = [
     "tla_raft_tpu.analysis",
     "tla_raft_tpu.analysis.ast_lint",
     "tla_raft_tpu.analysis.sanitize",
+    "tla_raft_tpu.service",
+    "tla_raft_tpu.service.bucket",
+    "tla_raft_tpu.service.queue",
+    "tla_raft_tpu.service.daemon",
 ]
 
 

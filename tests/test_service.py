@@ -1,0 +1,404 @@
+"""Sweep service: queue state machine, batched parity, crash recovery.
+
+The load-bearing contract is ISSUE 9's acceptance row: batched bucket
+execution must be BIT-IDENTICAL to sequential ``check.py`` runs —
+per-config distinct / generated / depth / level_sizes — on every test
+config, including violating ones (same violation kind, same counts at
+the stop point) and depth-capped ones.  The crash rows mirror the
+resilience suite's shape: a REAL subprocess SIGKILL'd mid-bucket by
+the deterministic fault plan, recovered by a second scheduler pass,
+converging to the uninterrupted answers.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tla_raft_tpu.check import run_check, summary_public
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.service.bucket import (
+    BatchedChecker,
+    bucket_key,
+    config_salts,
+)
+from tla_raft_tpu.service.daemon import Scheduler
+from tla_raft_tpu.service.queue import JobQueue, cfg_to_doc, doc_to_cfg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+S2 = RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1)
+
+PARITY_KEYS = ("ok", "distinct", "generated", "depth", "level_sizes")
+
+
+def _mr(cfg, mr, **kw):
+    return dataclasses.replace(cfg, max_restart=mr, **kw)
+
+
+def _service(*args, env=None, check=True):
+    e = dict(os.environ, JAX_PLATFORMS="cpu")
+    if env:
+        e.update(env)
+    p = subprocess.run(
+        [sys.executable, "-m", "tla_raft_tpu.service", *args],
+        cwd=REPO, env=e, capture_output=True, text=True,
+    )
+    if check:
+        assert p.returncode == 0, (p.returncode, p.stdout, p.stderr)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# queue state machine
+# ---------------------------------------------------------------------------
+
+
+def test_queue_roundtrip(tmp_path):
+    q = JobQueue(str(tmp_path), worker="wA")
+    jid = q.submit(S2, max_depth=5, options=dict(chunk=64))
+    assert q.list_jobs() == [jid]
+    spec = q.load_spec(jid)
+    assert spec["max_depth"] == 5
+    assert doc_to_cfg(spec["config"]) == S2
+    assert q.load_state(jid)["status"] == "submitted"
+    assert q.pending() == [jid]
+
+    # exclusive claim: second worker loses while the lease is live
+    assert q.claim(jid)
+    q2 = JobQueue(str(tmp_path), worker="wB")
+    assert not q2.claim(jid)
+    st = q.load_state(jid)
+    assert st["status"] == "running" and st["attempt"] == 1
+    assert st["worker"] == "wA"
+
+    q.heartbeat(jid, beats=3)
+    assert q.lease_age(jid) is not None
+
+    summary = dict(ok=True, distinct=7, generated=9, depth=3,
+                   level_sizes=[1, 2, 4], mxu=True, seconds=0.1,
+                   violation=None)
+    q.complete(jid, summary)
+    assert q.load_state(jid)["status"] == "done"
+    res = q.load_result(jid)
+    assert all(res[k] == summary[k] for k in PARITY_KEYS)
+    assert q.lease_age(jid) is None  # lease released
+    assert q.counts() == dict(submitted=0, running=0, done=1, failed=0)
+
+
+def test_queue_release_and_duplicate_submit(tmp_path):
+    q = JobQueue(str(tmp_path))
+    jid = q.submit(S2)
+    assert q.claim(jid)
+    q.release(jid, note="preempted")
+    st = q.load_state(jid)
+    assert st["status"] == "submitted" and st["attempt"] == 1
+    assert q.claim(jid)  # claimable again; attempt increments
+    assert q.load_state(jid)["attempt"] == 2
+    with pytest.raises(FileExistsError):
+        q.submit(S2, job_id=jid)
+
+
+def test_queue_stale_lease_requeue(tmp_path):
+    q = JobQueue(str(tmp_path), worker="dead", lease_ttl=0.05)
+    jid = q.submit(S2)
+    assert q.claim(jid)
+    # the "dead" worker never heartbeats: the lease goes stale and a
+    # scheduler pass requeues the job with the attempt preserved
+    time.sleep(0.1)
+    assert q.requeue_stale() == [jid]
+    st = q.load_state(jid)
+    assert st["status"] == "submitted" and st["attempt"] == 1
+    # a live lease is NOT requeued
+    q3 = JobQueue(str(tmp_path), worker="alive", lease_ttl=30.0)
+    assert q3.claim(jid)
+    assert q3.requeue_stale() == []
+
+
+def test_queue_torn_state_reads_as_submitted(tmp_path):
+    q = JobQueue(str(tmp_path))
+    jid = q.submit(S2)
+    # corrupt the state record in place: the digest-checked reader must
+    # treat it as absent -> the job reads as submitted, not stuck
+    with open(os.path.join(q.job_dir(jid), "state.json"), "r+b") as fh:
+        fh.seek(3)
+        fh.write(b"\xff")
+    assert q.load_state(jid)["status"] == "submitted"
+    assert q.pending() == [jid]
+
+
+def test_cfg_doc_roundtrip():
+    cfg = RaftConfig(n_servers=3, n_vals=2, max_election=2,
+                     max_restart=4, symmetry=False,
+                     invariants=("Inv", "~RaftCanCommt"),
+                     mutations=("double-vote",))
+    assert doc_to_cfg(cfg_to_doc(cfg)) == cfg
+
+
+# ---------------------------------------------------------------------------
+# bucketing
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_key_frees_only_max_restart():
+    assert bucket_key(_mr(S2, 0)) == bucket_key(_mr(S2, 7))
+    assert bucket_key(S2) != bucket_key(
+        dataclasses.replace(S2, n_servers=3)
+    )
+    assert bucket_key(S2) != bucket_key(
+        dataclasses.replace(S2, max_election=2)
+    )
+    assert bucket_key(S2) != bucket_key(
+        dataclasses.replace(S2, mutations=("double-vote",))
+    )
+    with pytest.raises(ValueError):
+        BatchedChecker([S2, dataclasses.replace(S2, n_servers=3)])
+
+
+def test_config_salts_distinct():
+    s = config_salts(64)
+    assert len(set(int(x) for x in s)) == 64
+    assert (s != 0).all()
+
+
+# ---------------------------------------------------------------------------
+# batched-vs-sequential bit-identical parity
+# ---------------------------------------------------------------------------
+
+
+def test_batched_parity_bucket():
+    """A mixed bucket — MaxRestart sweep, a duplicate config, a
+    depth-capped member — must reproduce each sequential run exactly."""
+    cfgs = [_mr(S2, 0), _mr(S2, 1), _mr(S2, 2), _mr(S2, 1)]
+    depths = [None, None, None, 4]
+    got = BatchedChecker(cfgs, max_depths=depths).run()
+    for cfg, d, g in zip(cfgs, depths, got):
+        want = summary_public(run_check(cfg, max_depth=d, chunk=64))
+        assert {k: g[k] for k in PARITY_KEYS} == {
+            k: want[k] for k in PARITY_KEYS
+        }, (cfg.max_restart, d)
+        assert g["violation"] is None
+        assert g["batched"] is True
+
+
+def test_batched_violation_parity():
+    """A violated (negated-probe) invariant stops each config at the
+    same counts and with the same violation string as check.py.
+    (Invariants are part of the bucket key, so the whole bucket runs
+    the probe; each member still stops independently.)"""
+    cfgs = [
+        _mr(S2, 0, invariants=("~RaftCanCommt",)),
+        _mr(S2, 1, invariants=("~RaftCanCommt",)),
+    ]
+    got = BatchedChecker(cfgs).run()
+    for cfg, g in zip(cfgs, got):
+        want = summary_public(run_check(cfg, chunk=64))
+        assert not want["ok"]  # the probe does fire on this model
+        for k in PARITY_KEYS + ("violation",):
+            assert g[k] == want[k], (cfg.max_restart, k)
+
+
+@pytest.mark.slow
+def test_batched_split_brain_abort_parity():
+    """The in-kernel Assert (double-vote mutation) aborts the config
+    with the engine's exact pre-level counts."""
+    base = RaftConfig(n_servers=3, n_vals=1, max_election=2,
+                      mutations=("double-vote",))
+    cfgs = [_mr(base, 0), _mr(base, 1)]
+    got = BatchedChecker(cfgs).run()
+    for cfg, g in zip(cfgs, got):
+        want = summary_public(run_check(cfg, chunk=64))
+        for k in PARITY_KEYS + ("violation",):
+            assert g[k] == want[k], (cfg.max_restart, k)
+        assert 'Assert "split brain"' in g["violation"]
+
+
+@pytest.mark.slow
+def test_batched_wide_bucket_shares_programs():
+    """>= 10 configs on one program ladder (the acceptance row's
+    shape), bit-identical to sequential runs."""
+    cfgs = [_mr(S2, mr) for mr in range(10)]
+    bc = BatchedChecker(cfgs)
+    got = bc.run()
+    assert bc.C == 10
+    # one trace per (entry point, shape) — the ladder is shared by all
+    # 10 configs, far fewer programs than 10 sequential compile ladders
+    assert bc.stats["programs"] < 2 * bc.stats["levels"]
+    for cfg, g in zip(cfgs, got):
+        want = summary_public(run_check(cfg, chunk=64))
+        assert {k: g[k] for k in PARITY_KEYS} == {
+            k: want[k] for k in PARITY_KEYS
+        }, cfg.max_restart
+
+
+# ---------------------------------------------------------------------------
+# scheduler: packing, drain, recovery
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_scheduler_packs_and_drains(tmp_path):
+    q = JobQueue(str(tmp_path))
+    jids = [
+        q.submit(_mr(S2, mr), options=dict(chunk=64)) for mr in (0, 1, 2)
+    ]
+    # a different shape key in the same queue: its own (singleton ->
+    # sequential) lane
+    solo = q.submit(
+        dataclasses.replace(S2, n_vals=2), max_depth=4,
+        options=dict(chunk=64),
+    )
+    sched = Scheduler(q, out=open(os.devnull, "w"))
+    stats = sched.run_once()
+    assert stats["jobs_done"] == 4 and stats["jobs_failed"] == 0
+    assert stats["batched_jobs"] == 3 and stats["max_bucket"] == 3
+    assert stats["sequential_jobs"] == 1
+    for jid, mr in zip(jids, (0, 1, 2)):
+        res = q.load_result(jid)
+        want = summary_public(run_check(_mr(S2, mr), chunk=64))
+        assert {k: res[k] for k in PARITY_KEYS} == {
+            k: want[k] for k in PARITY_KEYS
+        }
+    want = summary_public(
+        run_check(dataclasses.replace(S2, n_vals=2), max_depth=4,
+                  chunk=64)
+    )
+    res = q.load_result(solo)
+    assert {k: res[k] for k in PARITY_KEYS} == {
+        k: want[k] for k in PARITY_KEYS
+    }
+
+
+def test_sigkill_mid_bucket_recovers_and_converges(tmp_path):
+    """SIGKILL the worker at the 4th bucket-snapshot commit; a second
+    scheduler pass requeues the stale-leased jobs, RESUMES the bucket
+    from its adopted snapshot and converges to the clean answers."""
+    root = str(tmp_path / "q")
+    for mr in (0, 1, 2):
+        _service(
+            "submit", "--root", root, "--servers", "2", "--vals", "1",
+            "--max-election", "1", "--max-restart", str(mr),
+            "--chunk", "64",
+        )
+    p = _service(
+        "run", "--root", root, "--once",
+        env={"TLA_RAFT_FAULT": "bstate.commit:kill@4"}, check=False,
+    )
+    assert p.returncode == -signal.SIGKILL, (p.returncode, p.stderr)
+    q = JobQueue(root, lease_ttl=0.0)
+    assert q.counts()["running"] == 3  # died holding its claims
+    # a bucket snapshot survived the kill
+    bdirs = os.listdir(os.path.join(root, "buckets"))
+    assert len(bdirs) == 1
+    p = _service(
+        "run", "--root", root, "--once", "--lease-ttl", "0.1",
+    )
+    stats = json.loads(p.stdout.strip().splitlines()[-1])
+    assert stats["recovered"] == 3
+    assert stats["counts"] == dict(
+        submitted=0, running=0, done=3, failed=0
+    )
+    # pinned sequential fixpoints of (2,1,1,mr) — full level-by-level
+    # batched-vs-sequential parity is test_batched_parity_bucket's job
+    golden = {0: (27, 11), 1: (50, 12), 2: (50, 12)}
+    for jid in q.list_jobs():
+        res = q.load_result(jid)
+        cfg = q.job_cfg(jid)
+        assert res["ok"] is True
+        assert (res["distinct"], res["depth"]) == golden[cfg.max_restart]
+
+
+def test_sigkill_mid_sequential_job_resumes(tmp_path):
+    """A sequential (singleton) job killed mid-run resumes from its
+    per-job delta log instead of restarting (the --recover machinery
+    behind the queue)."""
+    root = str(tmp_path / "q")
+    _service(
+        "submit", "--root", root, "--servers", "2", "--vals", "1",
+        "--max-election", "1", "--max-restart", "1", "--chunk", "64",
+    )
+    p = _service(
+        "run", "--root", root, "--once",
+        env={"TLA_RAFT_FAULT": "delta.commit:kill@5"}, check=False,
+    )
+    assert p.returncode == -signal.SIGKILL
+    q = JobQueue(root)
+    (jid,) = q.list_jobs()
+    # the per-job checkpoint dir holds the killed run's delta log
+    assert any(
+        f.startswith("delta_") for f in os.listdir(q.ck_dir(jid))
+    )
+    p = _service("run", "--root", root, "--once", "--lease-ttl", "0.1")
+    assert "(resuming)" in p.stderr, p.stderr
+    res = q.load_result(jid)
+    # the pinned (2,1,1,1) fixpoint the CLI/resilience suites gate on
+    assert res["ok"] is True
+    assert (res["distinct"], res["depth"]) == (50, 12)
+
+
+# ---------------------------------------------------------------------------
+# results API / CLI schema
+# ---------------------------------------------------------------------------
+
+
+def test_results_api_schema(tmp_path):
+    """submit/status/results --json round-trip; results emits the
+    check.py --json summary schema."""
+    root = str(tmp_path / "q")
+    p = _service(
+        "submit", "--root", root, "--servers", "2", "--vals", "1",
+        "--max-election", "1", "--max-restart", "0", "--max-depth", "3",
+        "--chunk", "64", "--json",
+    )
+    sub = json.loads(p.stdout)
+    (jid,) = sub["submitted"]
+    p = _service("status", "--root", root, "--job", jid, "--json")
+    assert json.loads(p.stdout)["status"] == "submitted"
+    # no result yet -> exit 4
+    p = _service("results", "--root", root, jid, "--json", check=False)
+    assert p.returncode == 4
+    _service("run", "--root", root, "--once")
+    p = _service("status", "--root", root, "--json")
+    assert json.loads(p.stdout)["done"] == 1
+    p = _service("results", "--root", root, jid, "--json")
+    res = json.loads(p.stdout)
+    # the check.py --json schema, key for key
+    want = summary_public(run_check(_mr(S2, 0), max_depth=3, chunk=64))
+    for k in ("ok", "distinct", "generated", "depth", "level_sizes",
+              "mxu", "violation"):
+        assert res[k] == want[k], k
+    assert isinstance(res["seconds"], float)
+
+
+def test_run_check_summary_matches_cli_json(tmp_path):
+    """The programmatic run_check summary is the CLI --json line."""
+    cfgfile = tmp_path / "t.cfg"
+    cfgfile.write_text(
+        "CONSTANTS\n MaxRestart = 1\n MaxElection = 1\n"
+        " Follower = Follower\n Candidate = Candidate\n"
+        " Leader = Leader\n None = None\n VoteReq = VoteReq\n"
+        " VoteResp = VoteResp\n AppendReq = AppendReq\n"
+        " AppendResp = AppendResp\n s1 = s1\n s2 = s2\n"
+        " Servers = {s1, s2}\n v1 = v1\n Vals = {v1}\n"
+        "SYMMETRY symmServers\nVIEW view\nINIT Init\nNEXT Next\n"
+        "INVARIANT\nInv\n"
+    )
+    e = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-m", "tla_raft_tpu.check", "--config",
+         str(cfgfile), "--chunk", "64", "--max-depth", "5",
+         "--log", "-", "--json"],
+        cwd=REPO, env=e, capture_output=True, text=True, check=True,
+    )
+    cli = [json.loads(ln) for ln in p.stdout.splitlines()
+           if ln.startswith("{")][-1]
+    api = summary_public(
+        run_check(_mr(S2, 1), max_depth=5, chunk=64)
+    )
+    for k in ("ok", "distinct", "generated", "depth", "level_sizes"):
+        assert cli[k] == api[k], k

@@ -192,6 +192,216 @@ def _supervise(args, raw_argv) -> int:
         )
 
 
+class _Silent:
+    def write(self, s):
+        pass
+
+    def flush(self):
+        pass
+
+
+def summarize(res, chk=None, seconds: float | None = None) -> dict:
+    """CheckResult -> the canonical ``--json`` summary schema.
+
+    The one place the schema is defined: the CLI's ``--json`` line, the
+    sweep service's ``result.json`` records and the programmatic
+    :func:`run_check` return value all come from here, so they can
+    never drift apart.  Keys beginning with ``_`` carry non-JSON
+    payloads (the raw result/checker objects) and are stripped by
+    :func:`summary_public` before anything is serialized.
+    """
+    return dict(
+        ok=res.ok,
+        distinct=res.distinct,
+        generated=res.generated,
+        depth=res.depth,
+        # the crash-matrix tests diff these against an
+        # uninterrupted run's, level by level
+        level_sizes=list(res.level_sizes),
+        mxu=getattr(chk, "use_mxu", None),
+        seconds=round(seconds, 3) if seconds is not None else None,
+        violation=res.violation[0] if res.violation else None,
+    )
+
+
+def summary_public(summary: dict) -> dict:
+    """The JSON-serializable view of a :func:`run_check` summary."""
+    return {k: v for k, v in summary.items() if not k.startswith("_")}
+
+
+def run_check(
+    cfg: RaftConfig,
+    *,
+    backend: str = "jax",
+    max_depth: int | None = None,
+    chunk: int = 1024,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 1,
+    recover: str | None = None,
+    fpstore_dir: str | None = None,
+    mesh: int = 0,
+    exchange: str = "all_to_all",
+    mesh_deep: bool = False,
+    seg_rows: int = 1 << 15,
+    sieve: bool = True,
+    compress: bool = True,
+    cap_x: int = 4096,
+    canon: str = "late",
+    use_hashstore: bool | None = None,
+    pipeline: bool | None = None,
+    pipeline_window: int | None = None,
+    prewarm: bool | None = None,
+    use_mxu: bool | None = None,
+    progress=None,
+    out=None,
+    install_signals: bool = False,
+) -> dict:
+    """One in-process model-checking run -> the ``--json`` summary dict.
+
+    The programmatic core ``main`` used to inline: the sweep service,
+    the bench harness and the tests all invoke the checker through
+    here instead of shelling out through argv.  ``out`` (a writable
+    stream, or None for silence) receives the same informational lines
+    the CLI prints; ``progress`` is the per-level stats callback.
+    Raises ``resilience.Preempted`` on cooperative preemption (the CLI
+    maps it to exit 75) and propagates engine errors as exceptions —
+    policy (exit codes, tee logs, trace pretty-printing) stays with the
+    caller.  Extra ``_res`` / ``_chk`` / ``_sanitizer`` keys carry the
+    raw objects for callers that need the violation trace or the
+    exchange meter; ``summary_public`` strips them.
+    """
+    if mesh_deep and not mesh:
+        raise ValueError("mesh_deep requires mesh >= 1")
+    if mesh_deep and not fpstore_dir:
+        raise ValueError("mesh_deep requires fpstore_dir")
+    out = out if out is not None else _Silent()
+    t0 = time.monotonic()
+    sanitizer = None
+    chk = None  # the engine instance (None on the oracle backend)
+    if backend == "oracle":
+        from .oracle import OracleChecker
+
+        res = OracleChecker(cfg).run(max_depth=max_depth)
+    else:
+        from . import resilience
+        from .platform import setup_jax
+
+        jax = setup_jax()
+        if install_signals:
+            # SIGTERM/SIGINT request a cooperative preemption: the
+            # engine finishes the in-flight level, flushes its
+            # checkpoints, and raises Preempted -> exit 75 (resumable);
+            # a second signal kills immediately.  CLI-only — library
+            # callers (the service daemon owns its own handlers) poll
+            # the flag.
+            resilience.install_signal_handlers()
+
+        from .engine import JaxChecker
+
+        if os.environ.get("GRAFT_SANITIZE") == "1":
+            # graftlint layer 3 (docs/ANALYSIS.md): host-transfer ledger
+            # + per-level compile-count ledger + dispatch-thread guard
+            from .analysis.sanitize import Sanitizer
+
+            sanitizer = Sanitizer()
+            print(
+                f"Sanitizer: armed (warmup {sanitizer.warmup_levels} "
+                f"levels, {'strict' if sanitizer.strict else 'counting'} "
+                "transfer guard)",
+                file=out,
+            )
+
+        print(f"Devices: {jax.devices()}", file=out)
+
+        host_store = None  # single-device external store (mesh has its own)
+        if fpstore_dir and not mesh:
+            from .native import HostFPStore
+
+            host_store = HostFPStore(fpstore_dir)
+            if not recover:
+                # sweep run files orphaned by a crashed earlier process
+                # (never loaded, but they waste disk and shadow names)
+                host_store.clear()
+            print(f"Native FP store: {fpstore_dir}", file=out)
+
+        sanctx = sanitizer if sanitizer is not None else (
+            contextlib.nullcontext()
+        )
+        if mesh:
+            if fpstore_dir:
+                # mesh x external store: one HostFPStore per owner shard
+                # (fp % D), host-filtered after the all_to_all routing
+                print(f"Native FP store (owner-sharded x{mesh}): "
+                      f"{fpstore_dir}", file=out)
+            from .parallel import ShardedChecker, make_mesh
+
+            chk = ShardedChecker(
+                cfg, make_mesh(mesh), cap_x=cap_x,
+                exchange=exchange, progress=progress, canon=canon,
+                host_store_dir=fpstore_dir or None,
+                deep=mesh_deep, seg_rows=seg_rows,
+                sieve=sieve, compress=compress,
+                use_hashstore=(
+                    True if use_hashstore is None else use_hashstore
+                ),
+                pipeline=pipeline,
+                pipeline_window=pipeline_window,
+                use_mxu=use_mxu,
+            )
+            with sanctx:
+                res = chk.run(
+                    max_depth=max_depth,
+                    checkpoint_dir=checkpoint_dir,
+                    checkpoint_every=checkpoint_every,
+                    resume_from=recover,
+                )
+            if mesh_deep and chk.meter.levels:
+                # run-summary exchange ledger: the sieve+compress bytes
+                # vs what the uncompressed exchange would have moved
+                s = chk.meter.summary()
+                print(
+                    f"Exchange: {s['exchanged_bytes']:,} fp bytes over "
+                    f"{s['levels']} levels (uncompressed equivalent "
+                    f"{s['raw_bytes']:,}; reduction {s['reduction']}x; "
+                    f"sieved {s['sieved']:,} of {s['candidates']:,} "
+                    "candidates)",
+                    file=out,
+                )
+                for lv in s["per_level"]:
+                    print(
+                        f"  level {lv['level']}: {lv['exchanged_bytes']:,}"
+                        f" B (raw {lv['raw_bytes']:,} B, "
+                        f"x{lv['reduction']}), sieved {lv['n_sieved']:,}"
+                        f"/{lv['n_candidates']:,}",
+                        file=out,
+                    )
+        else:
+            with sanctx:
+                chk = JaxChecker(
+                    cfg, chunk=chunk, progress=progress,
+                    host_store=host_store, canon=canon,
+                    use_hashstore=(
+                        True if use_hashstore is None else use_hashstore
+                    ),
+                    pipeline=pipeline,
+                    pipeline_window=pipeline_window,
+                    use_mxu=use_mxu,
+                    prewarm=prewarm,
+                )
+                res = chk.run(
+                    max_depth=max_depth,
+                    checkpoint_dir=checkpoint_dir,
+                    checkpoint_every=checkpoint_every,
+                    resume_from=recover,
+                )
+
+    summary = summarize(res, chk, time.monotonic() - t0)
+    summary["_res"] = res
+    summary["_chk"] = chk
+    summary["_sanitizer"] = sanitizer
+    return summary
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tla_raft_tpu.check")
     p.add_argument("--config", default="/root/reference/Raft.cfg",
@@ -366,145 +576,61 @@ def main(argv=None) -> int:
             return 2
         print(f"Spec {spec_path}: structure matches compiled semantics.", file=out)
 
-    sanitizer = None
-    chk = None  # the engine instance (None on the oracle backend)
-    if args.backend == "oracle":
-        from .oracle import OracleChecker
-
-        res = OracleChecker(cfg).run(max_depth=args.max_depth)
-    else:
-        from . import resilience
-        from .platform import setup_jax
-
-        jax = setup_jax()
-        # SIGTERM/SIGINT request a cooperative preemption: the engine
-        # finishes the in-flight level, flushes its checkpoints, and
-        # raises Preempted -> exit 75 (resumable); a second signal
-        # kills immediately.  CLI-only — libraries poll the flag.
-        resilience.install_signal_handlers()
-
-        from .engine import JaxChecker
-
-        if os.environ.get("GRAFT_SANITIZE") == "1":
-            # graftlint layer 3 (docs/ANALYSIS.md): host-transfer ledger
-            # + per-level compile-count ledger + dispatch-thread guard
-            from .analysis.sanitize import Sanitizer
-
-            sanitizer = Sanitizer()
-            print(
-                f"Sanitizer: armed (warmup {sanitizer.warmup_levels} "
-                f"levels, {'strict' if sanitizer.strict else 'counting'} "
-                "transfer guard)",
-                file=out,
-            )
-
-        print(f"Devices: {jax.devices()}", file=out)
-
-        def progress(s):
-            rate = s["distinct"] / max(s["elapsed"], 1e-9)
-            print(
-                f"Progress: level {s['level']}, frontier {s['frontier']}, "
-                f"distinct {s['distinct']}, generated {s['generated']}, "
-                f"{rate:,.0f} states/s",
-                file=out,
-            )
-            out.flush()
-
-        host_store = None  # single-device external store (mesh has its own)
-        if args.fpstore_dir and not args.mesh:
-            from .native import HostFPStore
-
-            host_store = HostFPStore(args.fpstore_dir)
-            if not args.recover:
-                # sweep run files orphaned by a crashed earlier process
-                # (never loaded, but they waste disk and shadow names)
-                host_store.clear()
-            print(f"Native FP store: {args.fpstore_dir}", file=out)
-
-        sanctx = sanitizer if sanitizer is not None else (
-            contextlib.nullcontext()
+    def progress(s):
+        rate = s["distinct"] / max(s["elapsed"], 1e-9)
+        print(
+            f"Progress: level {s['level']}, frontier {s['frontier']}, "
+            f"distinct {s['distinct']}, generated {s['generated']}, "
+            f"{rate:,.0f} states/s",
+            file=out,
         )
-        if args.mesh:
-            if args.prewarm:
-                print("--prewarm applies to the single-device engine "
-                      "only; the mesh level loops compile their program "
-                      "set in line (flag ignored)", file=out)
-            if args.mesh_deep and not args.fpstore_dir:
-                print("--mesh-deep requires --fpstore-dir (the sharded "
-                      "deep sweep filters through per-owner external "
-                      "stores)", file=out)
-                return 2
-            if args.fpstore_dir:
-                # mesh x external store: one HostFPStore per owner shard
-                # (fp % D), host-filtered after the all_to_all routing
-                print(f"Native FP store (owner-sharded x{args.mesh}): "
-                      f"{args.fpstore_dir}", file=out)
-            from .parallel import ShardedChecker, make_mesh
+        out.flush()
 
-            chk = ShardedChecker(
-                cfg, make_mesh(args.mesh), cap_x=args.cap_x,
-                exchange=args.exchange, progress=progress, canon=args.canon,
-                host_store_dir=args.fpstore_dir or None,
-                deep=args.mesh_deep, seg_rows=args.seg_rows,
-                sieve=not args.no_sieve, compress=not args.no_compress,
-                use_hashstore=not args.no_hashstore,
-                pipeline=False if args.no_pipeline else None,
-                pipeline_window=args.pipeline_window,
-                use_mxu=_mxu_arg(args),
-            )
-            try:
-                with sanctx:
-                    res = chk.run(
-                        max_depth=args.max_depth,
-                        checkpoint_dir=args.checkpoint_dir,
-                        checkpoint_every=args.checkpoint_every,
-                        resume_from=args.recover,
-                    )
-            except resilience.Preempted as e:
-                return _report_preempted(e, out, logf)
-            if args.mesh_deep and chk.meter.levels:
-                # run-summary exchange ledger: the sieve+compress bytes
-                # vs what the uncompressed exchange would have moved
-                s = chk.meter.summary()
-                print(
-                    f"Exchange: {s['exchanged_bytes']:,} fp bytes over "
-                    f"{s['levels']} levels (uncompressed equivalent "
-                    f"{s['raw_bytes']:,}; reduction {s['reduction']}x; "
-                    f"sieved {s['sieved']:,} of {s['candidates']:,} "
-                    "candidates)",
-                    file=out,
-                )
-                for lv in s["per_level"]:
-                    print(
-                        f"  level {lv['level']}: {lv['exchanged_bytes']:,}"
-                        f" B (raw {lv['raw_bytes']:,} B, "
-                        f"x{lv['reduction']}), sieved {lv['n_sieved']:,}"
-                        f"/{lv['n_candidates']:,}",
-                        file=out,
-                    )
-        else:
-            try:
-                with sanctx:
-                    chk = JaxChecker(
-                        cfg, chunk=args.chunk, progress=progress,
-                        host_store=host_store, canon=args.canon,
-                        use_hashstore=not args.no_hashstore,
-                        pipeline=False if args.no_pipeline else None,
-                        pipeline_window=args.pipeline_window,
-                        use_mxu=_mxu_arg(args),
-                        prewarm=(
-                            None if args.prewarm is None
-                            else bool(args.prewarm)
-                        ),
-                    )
-                    res = chk.run(
-                        max_depth=args.max_depth,
-                        checkpoint_dir=args.checkpoint_dir,
-                        checkpoint_every=args.checkpoint_every,
-                        resume_from=args.recover,
-                    )
-            except resilience.Preempted as e:
-                return _report_preempted(e, out, logf)
+    if args.mesh and args.prewarm:
+        print("--prewarm applies to the single-device engine "
+              "only; the mesh level loops compile their program "
+              "set in line (flag ignored)", file=out)
+    if args.mesh and args.mesh_deep and not args.fpstore_dir:
+        print("--mesh-deep requires --fpstore-dir (the sharded "
+              "deep sweep filters through per-owner external "
+              "stores)", file=out)
+        return 2
+    from . import resilience
+
+    try:
+        summary = run_check(
+            cfg,
+            backend=args.backend,
+            max_depth=args.max_depth,
+            chunk=args.chunk,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            recover=args.recover,
+            fpstore_dir=args.fpstore_dir,
+            mesh=args.mesh,
+            exchange=args.exchange,
+            mesh_deep=args.mesh_deep,
+            seg_rows=args.seg_rows,
+            sieve=not args.no_sieve,
+            compress=not args.no_compress,
+            cap_x=args.cap_x,
+            canon=args.canon,
+            use_hashstore=not args.no_hashstore,
+            pipeline=False if args.no_pipeline else None,
+            pipeline_window=args.pipeline_window,
+            prewarm=(
+                None if args.prewarm is None else bool(args.prewarm)
+            ),
+            use_mxu=_mxu_arg(args),
+            progress=progress,
+            out=out,
+            install_signals=(args.backend != "oracle"),
+        )
+    except resilience.Preempted as e:
+        return _report_preempted(e, out, logf)
+    res = summary["_res"]
+    chk = summary["_chk"]
+    sanitizer = summary["_sanitizer"]
 
     dt = time.monotonic() - t0
     print(file=out)
@@ -537,22 +663,10 @@ def main(argv=None) -> int:
             print(f"  {name}: {n}", file=out)
     print(f"Finished in {dt:.1f}s ({res.distinct / max(dt, 1e-9):,.0f} distinct states/s).", file=out)
     if args.json:
-        print(
-            json.dumps(
-                dict(
-                    ok=res.ok,
-                    distinct=res.distinct,
-                    generated=res.generated,
-                    depth=res.depth,
-                    # the crash-matrix tests diff these against an
-                    # uninterrupted run's, level by level
-                    level_sizes=list(res.level_sizes),
-                    mxu=getattr(chk, "use_mxu", None),
-                    seconds=round(dt, 3),
-                )
-            ),
-            file=out,
-        )
+        # the one schema (summarize): ok/distinct/generated/depth/
+        # level_sizes/mxu/seconds/violation — shared with run_check and
+        # the sweep service's result.json records
+        print(json.dumps(summarize(res, chk, dt)), file=out)
     if logf:
         logf.close()
     if res.ok and sanitizer is not None and not sanitizer.ok:

@@ -20,9 +20,15 @@ def setup_jax():
 
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # TLA_RAFT_COMPILE_CACHE overrides the location (benches pin each
+    # A/B arm to a FRESH dir — a warm ambient cache pre-pays exactly
+    # the compile ladder an arm is trying to measure)
     jax.config.update(
         "jax_compilation_cache_dir",
-        os.path.expanduser("~/.cache/tla_raft_tpu_jax"),
+        os.environ.get(
+            "TLA_RAFT_COMPILE_CACHE",
+            os.path.expanduser("~/.cache/tla_raft_tpu_jax"),
+        ),
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return jax

@@ -20,8 +20,10 @@ from .manifest import (  # noqa: F401
     Manifest,
     RunMismatch,
     adopt_file,
+    commit_json,
     commit_npz,
     digest_file,
+    load_json_verified,
     run_config_fingerprint,
 )
 from .recover import (  # noqa: F401

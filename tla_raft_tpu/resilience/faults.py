@@ -67,6 +67,18 @@ FAULT_SITES = {
     "level.start": "top of a BFS level (both engines)",
     "pipeline.window": "async-pipeline fetch-group submit (the Nth "
                        "group entering the in-flight window)",
+    # sweep-service artifacts (service/queue.py, service/bucket.py):
+    # the same <kind>.tmp / <kind>.commit pair every atomic writer gets
+    "job.tmp": "service job spec: tmp written, not renamed",
+    "job.commit": "service job spec: renamed, not manifested",
+    "jobstate.tmp": "service state record: tmp written, not renamed",
+    "jobstate.commit": "service state record: renamed, not manifested",
+    "result.tmp": "service result record: tmp written, not renamed",
+    "result.commit": "service result record: renamed, not manifested",
+    "lease.tmp": "service worker lease: tmp written, not renamed",
+    "lease.commit": "service worker lease: renamed (unmanifested kind)",
+    "bstate.tmp": "bucket snapshot: tmp written, not renamed",
+    "bstate.commit": "bucket snapshot: renamed, not manifested",
 }
 
 _ACTIONS = ("kill", "torn", "flip", "fail")

@@ -299,6 +299,73 @@ def commit_npz(
     return final
 
 
+def commit_json(
+    ckdir: str,
+    name: str,
+    doc: dict,
+    *,
+    kind: str,
+    depth: int = -1,
+    run_fp: str | None = None,
+    manifest: bool = True,
+) -> str:
+    """The atomic JSON twin of :func:`commit_npz`.
+
+    The sweep service's queue records (job specs, state transitions,
+    leases, result summaries) are JSON documents, not arrays — but they
+    are checkpoint artifacts all the same: a torn ``state.json`` is a
+    stuck job, a torn ``result.json`` is a lost verdict.  Same steps:
+    tmp write -> digest -> ``os.replace`` -> manifest entry, with the
+    same ``<kind>.tmp`` / ``<kind>.commit`` fault sites so the crash
+    matrix covers the queue exactly like the delta log.  Pass
+    ``manifest=False`` for high-churn records whose loss is benign
+    (worker lease heartbeats): the write stays atomic but skips the
+    per-directory ledger commit.
+    """
+    os.makedirs(ckdir, exist_ok=True)
+    tmp = os.path.join(ckdir, TMP_PREFIX + name)
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True, default=str)
+        fh.write("\n")
+    faults.fire(f"{kind}.tmp", tmp)
+    algo, dig = digest_file(tmp)
+    nbytes = os.path.getsize(tmp)
+    final = os.path.join(ckdir, name)
+    os.replace(tmp, final)
+    faults.fire(f"{kind}.commit", final)
+    if manifest:
+        m = Manifest.load(ckdir)
+        m.bind_run(run_fp)
+        m.record(name, kind=kind, depth=depth, algo=algo, digest=dig,
+                 nbytes=nbytes)
+        m.commit()
+    return final
+
+
+def load_json_verified(ckdir: str, name: str):
+    """Load a JSON artifact, digest-checked against the directory's
+    manifest when an entry exists (``commit_json``'s read side).
+
+    Returns the parsed document, or ``None`` when the file is missing
+    OR fails verification/parsing — queue readers treat a torn or
+    corrupt record exactly like an absent one (the state machine
+    re-derives it from the surviving records; nothing here is the
+    source of truth, matching the manifest-layer contract).
+    """
+    path = os.path.join(ckdir, name)
+    m = Manifest.load(ckdir)
+    status = m.verify(name)
+    if status in ("missing", "corrupt"):
+        return None
+    if status == "unmanifested" and not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
 def adopt_file(ckdir: str, name: str, *, kind: str, depth: int = -1,
                run_fp: str | None = None) -> None:
     """Manifest an artifact that landed by copy rather than through

@@ -1,0 +1,17 @@
+"""Sweep service: config-batched checking + a multi-tenant job queue.
+
+Layers (docs/SERVICE.md):
+
+* ``bucket``  — the batched device-execution core: shape-bucketed
+  configs stacked into one flat frontier, one compiled program per
+  bucket key, per-config live masks and abort/fixpoint flags.
+* ``queue``   — the directory-backed job queue; every transition
+  commits through the resilience atomic writer (``commit_json``).
+* ``daemon``  — the scheduler: bucket packing, lease-based claims,
+  crash recovery, preemption-aware drain.
+
+CLI: ``python -m tla_raft_tpu.service {submit,status,results,run}``.
+"""
+
+from .bucket import BatchedChecker, bucket_key  # noqa: F401
+from .queue import JobQueue  # noqa: F401

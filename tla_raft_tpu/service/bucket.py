@@ -1,0 +1,700 @@
+"""Config-batched checking: many CONSTANT bindings, one dispatch stream.
+
+Production sweep traffic is huge numbers of *small* configs (CI runs,
+parameter sweeps, per-user models), each paying the ~38 ms/dispatch
+fixed cost and the compile ladder alone when run through a per-config
+``check.py`` process (docs/PERF.md round-2 findings; ROADMAP item 2).
+This module is the batched device-execution core of the sweep service:
+it stacks the state spaces of a whole **shape bucket** of configs into
+ONE flat frontier and runs the existing expand / fingerprint /
+probe-and-insert kernels over the union, so hundreds of small state
+spaces ride a single dispatch stream and share a single compiled
+program ladder.
+
+**Shape bucket.**  Every tensor shape and every hash table in the
+pipeline derives from (S, Vals, MaxElection): the state layout from
+(S, L=V+1), the message universe and fingerprint tables from
+(S, V, T=MaxElection).  ``MaxRestart`` is the one CONSTANT that appears
+*only* as a guard threshold (``restartCount < MaxRestart`` in the
+Restart family) — it never shapes a tensor and never enters a hash
+table.  The bucket key is therefore the config with ``max_restart``
+struck out (:func:`bucket_key`): configs in one bucket differ only in
+MaxRestart (and per-job depth caps), the bucket kernel is compiled once
+at the bucket's MAX MaxRestart, and each config's tighter bound is
+applied as a per-row refinement mask on the Restart slots outside the
+kernel — ``role = Leader ∧ rc < min(mr_c, mr_max) ≡ rc < mr_c``, so the
+per-config guard semantics are exact, not approximated.
+
+**Per-config separation.**  Rows of the flat frontier carry a config
+id; fingerprints are salted per config (``fp ^ splitmix64(slot)``)
+before entering the ONE shared open-addressing slab, so dedup is
+config-scoped with the same 2^-64 collision odds the checker already
+accepts, while membership for the whole bucket is a single fused
+probe-and-insert.  Per-config liveness masks gate expansion;
+per-config abort / invariant / fixpoint flags retire configs
+independently (a violation in one tenant's model never stalls the
+rest of the bucket).
+
+**Parity.**  Because the bucket kernel, universe and fingerprint
+tables are byte-identical to the ones a sequential ``check.py`` run of
+each member builds (MaxRestart does not enter any of them), and the
+in-level representative rule is the same min-(fp_full, payload) group
+reduce, per-config ``distinct`` / ``generated`` / ``depth`` /
+``level_sizes`` are **bit-identical** to sequential runs
+(tests/test_service.py diffs them config by config).  Violating
+configs retire with the engine's exact stop-point counts and
+violation string (parity-gated too); the batched core deliberately
+keeps no per-level (parent, slot) spills, so a TLC-style
+counterexample *trace* needs a sequential ``check.py`` re-run of that
+one config (docs/SERVICE.md degradation ladder).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import resilience
+from ..config import RaftConfig
+from ..engine import forecast
+from ..engine.invariants import resolve_invariant_kernel
+from ..models.raft import RaftState, init_batch
+from ..ops import hashstore
+from ..ops.hashstore import SENT
+from ..ops.mxu_expand import mxu_enabled_by_env
+from ..ops.successor import get_kernel
+
+I32 = jnp.int32
+I64 = jnp.int64
+U64 = jnp.uint64
+
+# the Restart family's id in the slot grid (ops/successor.py family
+# table) — the one family whose guard reads max_restart
+RESTART_FAMILY = 11
+
+# bucket-state checkpoint records (crash-safe batched runs): write-once
+# per-level names, so the rename-beat-manifest crash window leaves an
+# UNMANIFESTED new record (adoptable, like the engine's delta log)
+# instead of making a rolling name look corrupt
+BSTATE_FMT = "bstate_{:04d}.npz"
+BSTATE_GLOB = "bstate_*.npz"
+
+_STATE_FIELDS = RaftState._fields
+
+
+def bucket_key(cfg: RaftConfig) -> RaftConfig:
+    """The shape-bucket key: the config with MaxRestart struck out.
+
+    Two configs share a compiled program iff their keys are equal (see
+    module docstring for why MaxRestart — and only MaxRestart — is the
+    free axis)."""
+    return dataclasses.replace(cfg, max_restart=0)
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """The splitmix64 finalizer (numpy u64, vectorized)."""
+    with np.errstate(over="ignore"):
+        z = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return z ^ (z >> np.uint64(31))
+
+
+def config_salts(n: int) -> np.ndarray:
+    """Per-config-slot fingerprint salts (deterministic, never zero-ish
+    by construction of splitmix64 on distinct inputs)."""
+    return _splitmix64(np.arange(1, n + 1, dtype=np.uint64))
+
+
+class BucketPrograms:
+    """The jitted device programs of one shape bucket, shared across
+    every bucket run of that key in the process (lru-cached below) —
+    the queue's whole compile ladder is paid once per (key, C) pair.
+
+    ``C`` is the pow2-padded config-slot count: the per-config segment
+    reductions bake it into the trace, so padding it quantizes the
+    program space (a 5-config and a 7-config bucket of the same key
+    share the C=8 programs)."""
+
+    def __init__(self, kcfg: RaftConfig, mxu: bool, C: int):
+        self.kcfg = kcfg
+        self.C = C
+        self.kern = get_kernel(kcfg, mxu=mxu)
+        self.fpr = self.kern.fpr
+        self.K = self.kern.K
+        self._fam_rs = jnp.asarray(self.kern.slot_family == RESTART_FAMILY)
+        self.inv_fns = [
+            (name, resolve_invariant_kernel(name))
+            for name in kcfg.invariants
+        ]
+        self.step = jax.jit(self._level_step)
+        self.mat = jax.jit(self._mat_step)
+        self.inv_ok = jax.jit(self._inv_ok)
+        # shape keys seen by the jitted entry points — the honest
+        # "programs traced" ledger behind the bench's
+        # configs-per-compile stat (jax's jit cache is keyed on
+        # exactly these abstract shapes)
+        self.shape_keys: set = set()
+
+    # -- traced bodies -----------------------------------------------------
+
+    def _inv_ok(self, st: RaftState):
+        ok = jnp.ones((st.voted_for.shape[0],), bool)
+        for _name, fn in self.inv_fns:
+            ok = ok & fn(self.kcfg, st, self.kern.tables)
+        return ok
+
+    def _level_step(self, st, live, crow, mr_row, salt_row, slab):
+        """One bucket level on the device: expand the whole flat
+        frontier, refine the Restart guards per config, salt + dedup +
+        visited-insert through the shared slab, and reduce the
+        per-config ledgers.  Returns
+        (slab', fresh bool[B*K], salted fps u64[B*K], gen i64[C],
+        new i64[C], abort bool[C], overflow)."""
+        K = self.K
+        msum = self.fpr.msg_hash(st.msgs)
+        exp = self.kern.expand(st, msum)
+        # per-row config lookup as a one-hot masked reduce (the repo's
+        # scatter/gather-free idiom; C is tiny)
+        oh = crow[:, None] == jnp.arange(self.C)[None, :]  # [B, C]
+        mr_of_row = jnp.where(oh, mr_row[None, :], 0).sum(1, dtype=I32)
+        salt_of_row = jnp.where(
+            oh, salt_row[None, :], jnp.uint64(0)
+        ).sum(1, dtype=jnp.uint64)
+        # per-config MaxRestart refinement: the kernel was compiled at
+        # the bucket max; a member's tighter bound masks its Restart
+        # slots here (rc < min(mr_c, mr_max) == rc < mr_c — exact)
+        rc = st.restart_count.astype(I32)
+        ok = live[:, None] & (
+            ~self._fam_rs[None, :] | (rc[:, None] < mr_of_row[:, None])
+        )
+        valid = exp.valid & ok
+        mult = jnp.where(valid, exp.mult, 0)
+        gen_c = jax.ops.segment_sum(
+            mult.sum(1).astype(I64), crow, num_segments=self.C
+        )
+        abort_c = (
+            jax.ops.segment_sum(
+                (exp.abort & live).astype(I64), crow, num_segments=self.C
+            )
+            > 0
+        )
+        B = live.shape[0]
+        vflat = valid.reshape(-1)
+        salt_flat = jnp.repeat(salt_of_row, K)
+        fps = jnp.where(
+            vflat, exp.fp_view.reshape(-1) ^ salt_flat, jnp.uint64(SENT)
+        )
+        keys = exp.fp_full.reshape(-1)  # unsalted: intra-group tie-break
+        pays = jnp.arange(B * K, dtype=I64)
+        slab2, fresh, _n, ovf = hashstore.probe_and_insert_impl(
+            slab, fps, keys, pays
+        )
+        new_c = jax.ops.segment_sum(
+            fresh.astype(I64), jnp.repeat(crow, K), num_segments=self.C
+        )
+        return slab2, fresh, fps, gen_c, new_c, abort_c, ovf
+
+    def _mat_step(self, st, rows, slots, n_g):
+        """Materialize the level's survivors into the next frontier and
+        scan the configured invariants over them in the same program."""
+        parents = jax.tree.map(lambda x: x[rows], st)
+        children = self.kern.materialize(parents, slots)
+        in_range = jnp.arange(rows.shape[0], dtype=I64) < n_g
+        bad = (~self._inv_ok(children)) & in_range
+        return children, bad
+
+    # -- cold-path helpers -------------------------------------------------
+
+    def bad_invariant_name(self, children: RaftState, idx: int) -> str:
+        """Which invariant a known-bad state violates (cold path,
+        mirrors engine/bfs._bad_invariant_name)."""
+        one = jax.tree.map(lambda x: x[idx: idx + 1], children)
+        for name, fn in self.inv_fns:
+            ok = jax.device_get(fn(self.kcfg, one, self.kern.tables))
+            if not bool(np.asarray(ok)[0]):
+                return name
+        return self.inv_fns[0][0]
+
+    def note_shapes(self, tag: str, *shapes) -> None:
+        self.shape_keys.add((tag,) + shapes)
+
+
+@functools.lru_cache(maxsize=32)
+def _get_programs(kcfg: RaftConfig, mxu: bool, C: int) -> BucketPrograms:
+    return BucketPrograms(kcfg, mxu, C)
+
+
+class BatchedChecker:
+    """One bucket run: N same-key configs checked as one device stream.
+
+    Parameters:
+      cfgs: the bucket members — every ``bucket_key(cfg)`` must match.
+      max_depths: optional per-config depth caps (None = fixpoint).
+      use_mxu: expand-kernel selector, as in JaxChecker.
+      progress: optional callable(stats dict) per level.
+
+    ``run(checkpoint_dir=...)`` commits a rolling ``bstate.npz`` bucket
+    snapshot through the atomic manifest writer after every level, and
+    resumes from it when the directory holds a digest-verified record
+    of the SAME job set (run-config fingerprint match) — a SIGKILL'd
+    bucket resumes rather than restarts.  Returns one summary dict per
+    config in the ``check.py --json`` schema.
+    """
+
+    def __init__(
+        self,
+        cfgs: list[RaftConfig],
+        max_depths: list[int | None] | None = None,
+        use_mxu: bool | None = None,
+        progress=None,
+    ):
+        if not cfgs:
+            raise ValueError("empty bucket")
+        self.cfgs = list(cfgs)
+        self.C = len(self.cfgs)
+        key = bucket_key(self.cfgs[0])
+        for c in self.cfgs[1:]:
+            if bucket_key(c) != key:
+                raise ValueError(
+                    f"bucket mixes shape keys: {bucket_key(c)} != {key}"
+                )
+        self.kcfg = dataclasses.replace(
+            key, max_restart=max(c.max_restart for c in self.cfgs)
+        )
+        if use_mxu is None:
+            use_mxu = mxu_enabled_by_env()
+        self.C_pad = max(2, forecast.pow2ceil(self.C))
+        self.progs = _get_programs(self.kcfg, bool(use_mxu), self.C_pad)
+        self.kern = self.progs.kern
+        self.use_mxu = self.kern.use_mxu
+        self.K = self.kern.K
+        self.max_depths = list(max_depths or [None] * self.C)
+        if len(self.max_depths) != self.C:
+            raise ValueError("max_depths length mismatch")
+        self.progress = progress
+        self.salts = config_salts(self.C_pad)
+        mr = [c.max_restart for c in self.cfgs]
+        self._mr = np.asarray(
+            mr + [0] * (self.C_pad - self.C), np.int32
+        )
+        # run identity for the bucket checkpoint: the job SET (bucket
+        # key + each member's (mr, depth cap) in slot order) — a
+        # different set must never adopt this bucket's snapshot
+        self._run_fp = resilience.run_config_fingerprint(
+            self.kcfg,
+            engine="service.bucket/1",
+            jobs=tuple(
+                (int(m), -1 if d is None else int(d))
+                for m, d in zip(mr, self.max_depths)
+            ),
+            mxu=self.use_mxu,
+        )
+        # stats for the bench record
+        self.stats = dict(levels=0, dispatches=0, programs=0, redos=0)
+
+    # -- slab management ---------------------------------------------------
+
+    def _fresh_slab(self, entries: int):
+        cap = max(
+            hashstore.MIN_CAP,
+            forecast.pow2ceil(hashstore.slab_rows(max(entries, 1), 0.25)),
+        )
+        return jnp.asarray(
+            np.full((cap,), SENT, np.uint64)
+        ), cap
+
+    def _rebuild_slab(self, all_fps: list[np.ndarray], cap: int):
+        fps = (
+            np.concatenate(all_fps)
+            if all_fps else np.zeros((0,), np.uint64)
+        )
+        while cap < 4 * max(len(fps), 1):
+            cap *= 2
+        slab_np = np.full((cap,), SENT, np.uint64)
+        slab_np = hashstore.insert_np(slab_np, fps)
+        return jnp.asarray(slab_np), cap
+
+    # -- checkpointing -----------------------------------------------------
+
+    def _save_bstate(self, ckdir, lvl, st_np, live, crow,
+                     all_fps, gen, depth, level_sizes, done, results):
+        arrays = {f"st_{f}": st_np[f] for f in _STATE_FIELDS}
+        maxlv = max(len(ls) for ls in level_sizes)
+        ls_pad = np.full((self.C, maxlv), -1, np.int64)
+        for i, ls in enumerate(level_sizes):
+            ls_pad[i, : len(ls)] = ls
+        # results are JSON-safe summary dicts (or None for running)
+        res_blob = json.dumps(results)
+        arrays.update(
+            lvl=np.int64(lvl),
+            live=live,
+            crow=crow,
+            all_fps=np.concatenate(all_fps)
+            if all_fps else np.zeros((0,), np.uint64),
+            gen=gen,
+            depth=depth,
+            level_sizes=ls_pad,
+            done=done,
+            results=np.frombuffer(res_blob.encode(), np.uint8),
+            run_fp=np.frombuffer(self._run_fp.encode(), np.uint8),
+        )
+        name = BSTATE_FMT.format(int(lvl))
+        resilience.commit_npz(
+            ckdir, name, arrays, kind="bstate", depth=int(lvl),
+            run_fp=self._run_fp,
+        )
+        # keep the latest two records (the previous one is the fallback
+        # if the newest turns out torn on the next resume); sweep older
+        import glob as _glob
+
+        old = sorted(_glob.glob(os.path.join(ckdir, BSTATE_GLOB)))[:-2]
+        if old:
+            m = resilience.Manifest.load(ckdir)
+            for p in old:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+                m.forget(os.path.basename(p))
+            m.commit()
+
+    @staticmethod
+    def _read_bstate(path):
+        try:
+            with np.load(path) as z:
+                return {k: z[k] for k in z.files}
+        except (OSError, ValueError, KeyError, EOFError):
+            return None
+
+    def _load_bstate(self, ckdir):
+        """Newest healable bucket snapshot, or None (fresh start).
+
+        Heal-first resume, the engine's delta-log policy shaped to the
+        snapshot log: newest record first — a digest-verified record of
+        this job set is used as-is; a structurally-valid UNMANIFESTED
+        record of this job set (the rename-beat-manifest crash window)
+        is ADOPTED into the ledger and used; anything torn, corrupt or
+        belonging to another job set is quarantined and the walk falls
+        back to the next-older record."""
+        import glob as _glob
+
+        resilience.sweep_tmp(ckdir)
+        names = sorted(
+            os.path.basename(p)
+            for p in _glob.glob(os.path.join(ckdir, BSTATE_GLOB))
+        )
+        m = resilience.Manifest.load(ckdir)
+        dirty = False
+        out = None
+        for name in reversed(names):
+            status = m.verify(name)
+            data = self._read_bstate(os.path.join(ckdir, name))
+            fp = (
+                bytes(data["run_fp"]).decode()
+                if data is not None and "run_fp" in data else None
+            )
+            if fp != self._run_fp:
+                resilience.quarantine(
+                    ckdir, name,
+                    "bstate unreadable" if data is None
+                    else "bstate from another job set", m,
+                )
+                dirty = True
+                continue
+            if status == "ok":
+                out = data
+                break
+            if status == "unmanifested":
+                if dirty:  # flush quarantine edits before adopt reloads
+                    m.commit()
+                    dirty = False
+                resilience.adopt_file(
+                    ckdir, name, kind="bstate", depth=int(data["lvl"]),
+                    run_fp=self._run_fp,
+                )
+                out = data
+                break
+            resilience.quarantine(ckdir, name, f"bstate {status}", m)
+            dirty = True
+        if dirty:
+            m.commit()
+        return out
+
+    # -- the run -----------------------------------------------------------
+
+    def run(self, checkpoint_dir: str | None = None) -> list[dict]:
+        t0 = time.monotonic()
+        C, C_pad, K = self.C, self.C_pad, self.K
+        progs = self.progs
+        # programs = the DELTA of traces this run added: the program
+        # cache is lru-shared across bucket runs of one key, so the
+        # cumulative ledger would double-count reuse (the whole point
+        # of sharing) as fresh compilation
+        progs_before = len(progs.shape_keys)
+        if checkpoint_dir:
+            resilience.sweep_tmp(checkpoint_dir)
+
+        results: list[dict | None] = [None] * C
+        done = np.zeros(C, bool)
+        gen = np.zeros(C, np.int64)
+        depth = np.zeros(C, np.int64)
+        level_sizes: list[list[int]] = [[1] for _ in range(C)]
+
+        def finish(c, ok, kind=None):
+            done[c] = True
+            results[c] = dict(
+                ok=bool(ok),
+                distinct=int(sum(level_sizes[c])),
+                generated=int(gen[c]),
+                depth=int(depth[c]),
+                level_sizes=[int(x) for x in level_sizes[c]],
+                mxu=self.use_mxu,
+                seconds=round(time.monotonic() - t0, 3),
+                violation=kind,
+                batched=True,
+                bucket_configs=C,
+            )
+
+        # ---- init level (or bucket-snapshot resume) ----------------------
+        ck = self._load_bstate(checkpoint_dir) if checkpoint_dir else None
+        if ck is not None:
+            lvl = int(ck["lvl"])
+            live_h = np.asarray(ck["live"], bool)
+            crow_h = np.asarray(ck["crow"], np.int64)
+            gen = np.asarray(ck["gen"], np.int64).copy()
+            depth = np.asarray(ck["depth"], np.int64).copy()
+            done = np.asarray(ck["done"], bool).copy()
+            ls_pad = np.asarray(ck["level_sizes"])
+            level_sizes = [
+                [int(x) for x in row[row >= 0]] for row in ls_pad
+            ]
+            res_list = json.loads(bytes(ck["results"]).decode())
+            for i, r in enumerate(res_list):
+                if r is not None:
+                    results[i] = r
+            all_fps = [np.asarray(ck["all_fps"], np.uint64)]
+            st = RaftState(
+                **{
+                    f: jnp.asarray(ck[f"st_{f}"])
+                    for f in _STATE_FIELDS
+                }
+            )
+            slab, _cap = self._rebuild_slab(
+                all_fps, hashstore.MIN_CAP
+            )
+        else:
+            lvl = 0
+            st1 = init_batch(self.kcfg, 1)
+            fv0, _ff0, _ms = progs.fpr.state_fingerprints(st1)
+            fp0 = np.asarray(jax.device_get(fv0)).astype(np.uint64)[0]
+            salted0 = (fp0 ^ self.salts[:C]).astype(np.uint64)
+            all_fps = [salted0]
+            slab, _cap = self._fresh_slab(64 * C)
+            slab_np = np.asarray(jax.device_get(slab))
+            slab_np = hashstore.insert_np(slab_np, salted0)
+            slab = jnp.asarray(slab_np)
+            # invariant check on Init (all members share the state)
+            ok0 = bool(
+                np.asarray(jax.device_get(progs.inv_ok(st1)))[0]
+            )
+            if not ok0:
+                name = progs.bad_invariant_name(st1, 0)
+                for c in range(C):
+                    finish(c, False, f"Invariant {name} is violated")
+                return [r for r in results if r is not None]
+            B0 = max(8, forecast.pow2ceil(C))
+            st = init_batch(self.kcfg, B0)
+            live_h = np.arange(B0) < C
+            crow_h = np.minimum(np.arange(B0), C - 1).astype(np.int64)
+
+        mr_dev = jnp.asarray(self._mr)
+        salt_dev = jnp.asarray(self.salts)
+        # bucket-aggregate per-level new-state totals: the forecast
+        # signal that presizes the frontier capacity ahead of growth
+        # (engine/forecast.py), so the bucket compiles one program per
+        # forecast magnitude instead of one per pow2 step it crawls
+        # through
+        level_totals = [
+            int(sum(ls[i] for ls in level_sizes if len(ls) > i))
+            for i in range(max(len(ls) for ls in level_sizes))
+        ]
+        g_floor = 8  # frontier-capacity ratchet (grows only: one
+        # program per magnitude, never a shrink retrace)
+
+        # ---- level loop --------------------------------------------------
+        while True:
+            # retire members that reached their depth cap (the engine
+            # breaks BEFORE expanding at max_depth — same here)
+            for c in range(C):
+                if (
+                    not done[c]
+                    and self.max_depths[c] is not None
+                    and depth[c] >= self.max_depths[c]
+                ):
+                    finish(c, True)
+                    live_h = live_h & (crow_h != c)
+            if done.all() or not live_h.any():
+                for c in range(C):
+                    if not done[c]:  # frontier drained externally
+                        finish(c, True)
+                break
+
+            B = int(live_h.shape[0])
+            live = jnp.asarray(live_h)
+            crow = jnp.asarray(crow_h)
+            while True:  # slab-overflow redo loop (engine-shaped)
+                progs.note_shapes("step", B, int(slab.shape[0]))
+                out = progs.step(st, live, crow, mr_dev, salt_dev, slab)
+                (slab2, fresh_d, fps_d, gen_d, new_d, abort_d,
+                 ovf_d) = out
+                fresh_h, fps_h, gen_c, new_c, abort_c, ovf = (
+                    jax.device_get(
+                        (fresh_d, fps_d, gen_d, new_d, abort_d, ovf_d)
+                    )
+                )
+                self.stats["dispatches"] += 1
+                if not bool(ovf):
+                    slab = slab2
+                    break
+                # probe-window overflow: rebuild a bigger slab from the
+                # inserted-fps ledger and redo the level (the pending
+                # slab2 is discarded — kernels are functional)
+                self.stats["redos"] += 1
+                slab, _cap = self._rebuild_slab(
+                    all_fps, 2 * int(slab.shape[0])
+                )
+            self.stats["levels"] += 1
+
+            # abort (in-kernel Assert) fires BEFORE the level is
+            # counted, like the engine's abort_at return
+            active = ~done
+            for c in range(C):
+                if active[c] and bool(abort_c[c]):
+                    finish(
+                        c, False, 'Assert "split brain" (Raft.tla:185)'
+                    )
+                    live_h = live_h & (crow_h != c)
+            for c in range(C):
+                if not done[c]:
+                    gen[c] += int(gen_c[c])
+
+            lanes = np.nonzero(fresh_h)[0]
+            lane_cfg = crow_h[lanes // K]
+            keep = ~done[lane_cfg]
+            lanes = lanes[keep]
+            lane_cfg = lane_cfg[keep]
+            if len(fps_h):
+                # ledger of every inserted fp (slab rebuild source) —
+                # includes retired members' lanes already in the slab
+                ins = np.nonzero(fresh_h)[0]
+                all_fps.append(fps_h[ins].astype(np.uint64))
+
+            for c in range(C):
+                if done[c]:
+                    continue
+                n_new = int(new_c[c])
+                if n_new == 0:
+                    finish(c, True)  # fixpoint: gen counted, depth kept
+                    live_h = live_h & (crow_h != c)
+                else:
+                    level_sizes[c].append(n_new)
+                    depth[c] += 1
+
+            lanes = lanes[~done[lane_cfg]]
+            n_g = len(lanes)
+            if n_g == 0:
+                for c in range(C):
+                    if not done[c]:
+                        finish(c, True)
+                break
+
+            level_totals.append(int(sum(int(x) for x in new_c[:C])))
+            rows = (lanes // K).astype(np.int64)
+            slots = (lanes % K).astype(np.int64)
+            crow_next = crow_h[rows]
+            G_cap = max(g_floor, forecast.pow2ceil(n_g))
+            if len(level_totals) > forecast.MIN_LEVELS:
+                # presize ONE magnitude ahead when the forecast says
+                # growth continues: saves the next pow2 retrace without
+                # inflating the padded per-level compute (a wide cap
+                # was measured 3x slower on CPU — dead padded lanes
+                # are not free)
+                peak = forecast.forecast_peak_new(level_totals, None)
+                peak = min(max(peak, n_g), 2 * max(n_g, 1), 1 << 20)
+                G_cap = max(G_cap, forecast.pow2ceil(peak))
+            g_floor = G_cap
+            rows_p = np.zeros(G_cap, np.int64)
+            rows_p[:n_g] = rows
+            slots_p = np.zeros(G_cap, np.int64)
+            slots_p[:n_g] = slots
+            progs.note_shapes("mat", B, G_cap)
+            children, bad_d = progs.mat(
+                st, jnp.asarray(rows_p), jnp.asarray(slots_p),
+                jnp.asarray(n_g, I64),
+            )
+            bad_h = np.asarray(jax.device_get(bad_d))
+            self.stats["dispatches"] += 1
+            lvl += 1
+
+            if self.progress is not None:
+                self.progress(
+                    dict(
+                        level=lvl,
+                        frontier=n_g,
+                        configs_alive=int((~done).sum()),
+                        distinct=int(sum(sum(ls) for ls in level_sizes)),
+                        generated=int(gen.sum()),
+                        elapsed=time.monotonic() - t0,
+                    )
+                )
+
+            crow_pad = np.zeros(G_cap, np.int64)
+            crow_pad[:n_g] = crow_next
+            live_next = np.zeros(G_cap, bool)
+            live_next[:n_g] = True
+            # invariant violations: counted level, then fail (engine
+            # order: bookkeeping -> bad check); first bad lane per
+            # config in lane order decides the reported invariant
+            if bad_h.any():
+                for i in np.nonzero(bad_h[:n_g])[0]:
+                    c = int(crow_pad[i])
+                    if done[c]:
+                        continue
+                    name = progs.bad_invariant_name(children, int(i))
+                    finish(c, False, f"Invariant {name} is violated")
+                live_next = live_next & ~done[crow_pad]
+            st = children
+            live_h = live_next
+            crow_h = crow_pad
+
+            if checkpoint_dir:
+                # size-aware cadence: the snapshot rewrites the WHOLE
+                # cumulative fps ledger + frontier, so past ~2M entries
+                # a per-level dump would re-add an O(|visited|) level
+                # tail — snapshot every 8th level there (a crash then
+                # redoes at most 7 levels from the previous record)
+                n_led = sum(len(a) for a in all_fps)
+                every = 1 if 8 * n_led <= (1 << 24) else 8
+                if lvl % every == 0:
+                    st_np = {
+                        f: np.asarray(jax.device_get(getattr(st, f)))
+                        for f in _STATE_FIELDS
+                    }
+                    self._save_bstate(
+                        checkpoint_dir, lvl, st_np, live_h, crow_h,
+                        all_fps, gen, depth, level_sizes, done, results,
+                    )
+
+        self.stats["programs"] = len(progs.shape_keys) - progs_before
+        out = [r for r in results if r is not None]
+        assert len(out) == C
+        return out

@@ -1,0 +1,316 @@
+"""The sweep scheduler: bucket packing, execution, crash recovery.
+
+One scheduler pass (:meth:`Scheduler.run_once`):
+
+1. **Recover** — requeue every running job whose worker lease went
+   stale (a SIGKILL'd worker's jobs come back; their checkpoint dirs
+   are intact so the retry resumes, not restarts).
+2. **Pack** — group the pending jobs by shape bucket
+   (:func:`bucket.bucket_key`); buckets are executed largest-first so
+   the device stream carries as many configs per dispatch as the queue
+   allows (the packing that amortizes the ~38 ms dispatch fixed cost
+   and the compile ladder, docs/PERF.md).
+3. **Execute** — a bucket of >= ``min_bucket`` batchable jobs runs
+   through :class:`bucket.BatchedChecker` (one dispatch stream, bucket
+   bstate checkpoint under ``root/buckets/<fp>/``); everything else
+   (mesh jobs, oracle jobs, singletons) runs sequentially through
+   :func:`check.run_check` with its per-job delta-log checkpoint dir.
+
+Degradation ladder (docs/SERVICE.md): batched bucket -> on an
+unexpected batched-core error, per-job sequential fallback -> on a
+sequential error, the job fails with the error recorded.  Preemption
+(SIGTERM) finishes the in-flight bucket level / job, releases
+unstarted claims and exits 75, exactly like ``check.py``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import threading
+import time
+
+from .. import resilience
+from ..check import run_check, summary_public
+from .bucket import BatchedChecker, bucket_key
+from .queue import JobQueue, doc_to_cfg
+
+
+class _Beater:
+    """Background lease renewal while a bucket/job runs.
+
+    Heartbeats every ttl/3 from a timer thread, so a minutes-class
+    compile (docs/PERF.md prices tunneled-TPU shapes in minutes) can
+    never age a LIVE worker's lease past the TTL and hand its job to a
+    second scheduler mid-run.  This thread is the lease's ONLY writer
+    during the run — a per-level callback beating concurrently would
+    race two writers onto one tmp path.  Writes files only; never
+    dispatches device programs (GL007)."""
+
+    def __init__(self, q: JobQueue, jids):
+        self.q = q
+        self.jids = list(jids)
+        self._stop = threading.Event()
+        self._t = threading.Thread(
+            target=self._run, name="lease-beater", daemon=True
+        )
+
+    def __enter__(self):
+        self._beat()
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join(5.0)
+
+    def _beat(self):
+        for j in self.jids:
+            try:
+                self.q.heartbeat(j)
+            except OSError:
+                pass  # lease swept mid-write: staleness logic decides
+
+    def _run(self):
+        period = max(0.5, self.q.lease_ttl / 3.0)
+        while not self._stop.wait(period):
+            self._beat()
+
+
+def _has_checkpoints(ckdir: str) -> bool:
+    import glob
+
+    return bool(
+        glob.glob(os.path.join(ckdir, "delta_*.npz"))
+        or glob.glob(os.path.join(ckdir, "mdelta_*.npz"))
+        or os.path.exists(os.path.join(ckdir, "base.npz"))
+    )
+
+
+class Scheduler:
+    """Drains a :class:`JobQueue` onto the local device stream."""
+
+    def __init__(
+        self,
+        queue: JobQueue,
+        batch: bool = True,
+        min_bucket: int = 2,
+        out=None,
+        use_mxu: bool | None = None,
+    ):
+        self.q = queue
+        self.batch = batch
+        self.min_bucket = max(1, int(min_bucket))
+        self.out = out if out is not None else sys.stderr
+        self.use_mxu = use_mxu
+        self.stats = dict(
+            jobs_done=0, jobs_failed=0, buckets=0, batched_jobs=0,
+            sequential_jobs=0, max_bucket=0, dispatches=0, programs=0,
+            recovered=0, config_dispatch_weight=0,
+        )
+
+    def _say(self, msg: str) -> None:
+        print(f"[service] {msg}", file=self.out)
+        self.out.flush()
+
+    # -- packing -------------------------------------------------------
+
+    def _batchable(self, spec: dict) -> bool:
+        opt = spec.get("options") or {}
+        return (
+            opt.get("backend", "jax") == "jax"
+            and not opt.get("mesh")
+            and not opt.get("fpstore_dir")
+        )
+
+    def plan(self, job_ids: list[str]):
+        """(buckets, singles): buckets maps a shape key to the job list
+        that can ride one compiled program."""
+        buckets: dict = {}
+        singles: list[tuple[str, dict]] = []
+        for jid in job_ids:
+            spec = self.q.load_spec(jid)
+            if spec is None:
+                # unreadable spec (submit died mid-commit / torn file):
+                # fail it now — a silently-skipped pending job would
+                # keep serve() from ever draining to idle
+                self._say(f"job {jid}: unreadable spec — failing")
+                self.q.fail_unreadable(jid, "unreadable job spec")
+                self.stats["jobs_failed"] += 1
+                continue
+            cfg = doc_to_cfg(spec["config"])
+            if self.batch and self._batchable(spec):
+                buckets.setdefault(bucket_key(cfg), []).append((jid, spec))
+            else:
+                singles.append((jid, spec))
+        # sub-minimum buckets execute sequentially (no amortization to
+        # be had); largest buckets first = best packing under a
+        # preemption that cuts the pass short
+        out = []
+        for key, jobs in buckets.items():
+            if len(jobs) >= self.min_bucket:
+                out.append((key, jobs))
+            else:
+                singles.extend(jobs)
+        out.sort(key=lambda kv: -len(kv[1]))
+        return out, singles
+
+    # -- execution -----------------------------------------------------
+
+    def _bucket_ck(self, run_fp_src: str) -> str:
+        h = hashlib.blake2b(run_fp_src.encode(), digest_size=8).hexdigest()
+        return os.path.join(self.q.root, "buckets", h)
+
+    def _run_bucket(self, key, jobs) -> None:
+        claimed = [(j, s) for j, s in jobs if self.q.claim(j)]
+        if not claimed:
+            return
+        jids = [j for j, _ in claimed]
+        cfgs = [doc_to_cfg(s["config"]) for _, s in claimed]
+        depths = [s.get("max_depth") for _, s in claimed]
+        self._say(
+            f"bucket {key.describe()}: {len(claimed)} configs "
+            f"(MaxRestart {sorted(c.max_restart for c in cfgs)})"
+        )
+        bc = BatchedChecker(
+            cfgs, max_depths=depths, use_mxu=self.use_mxu,
+        )
+        bdir = self._bucket_ck(bc._run_fp)
+        try:
+            with _Beater(self.q, jids):
+                summaries = bc.run(checkpoint_dir=bdir)
+        except resilience.Preempted:
+            for j in jids:
+                self.q.release(j, note="preempted mid-bucket")
+            raise
+        except Exception as e:  # graftlint: waive[GL003] degradation rung: any batched-core failure falls back to per-job sequential runs
+            self._say(
+                f"batched bucket failed ({type(e).__name__}: {e}); "
+                "degrading to sequential"
+            )
+            for j, s in claimed:
+                self.q.release(j, note="bucket degraded to sequential")
+                if self.q.claim(j):
+                    self._run_one(j, s)
+            return
+        for j, summary in zip(jids, summaries):
+            self.q.complete(j, summary)
+            self.stats["jobs_done" if summary["ok"] else "jobs_failed"] += 1
+        self.stats["buckets"] += 1
+        self.stats["batched_jobs"] += len(claimed)
+        self.stats["max_bucket"] = max(
+            self.stats["max_bucket"], len(claimed)
+        )
+        self.stats["dispatches"] += bc.stats["dispatches"]
+        # configs-per-dispatch numerator: every device dispatch of this
+        # bucket carried len(claimed) tenant configs
+        self.stats["config_dispatch_weight"] += (
+            len(claimed) * bc.stats["dispatches"]
+        )
+        # total NEW traces across the queue (per-run deltas: reuse of
+        # another bucket's cached programs adds nothing — that reuse is
+        # the amortization being measured)
+        self.stats["programs"] += bc.stats["programs"]
+        # the bucket converged: its snapshots are spent (a later bucket
+        # of the same key gets a fresh run_fp-checked record anyway,
+        # but leaving them costs disk per drained bucket)
+        import glob as _glob
+
+        for p in _glob.glob(os.path.join(bdir, "bstate_*.npz")):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    def _run_one(self, jid: str, spec: dict) -> None:
+        cfg = doc_to_cfg(spec["config"])
+        opt = spec.get("options") or {}
+        ck = self.q.ck_dir(jid)
+        recover = ck if _has_checkpoints(ck) else None
+        self._say(
+            f"job {jid}: sequential {cfg.describe()}"
+            + (" (resuming)" if recover else "")
+        )
+        try:
+            with _Beater(self.q, [jid]):
+                summary = run_check(
+                    cfg,
+                    backend=opt.get("backend", "jax"),
+                    max_depth=spec.get("max_depth"),
+                    chunk=int(opt.get("chunk", 1024)),
+                    checkpoint_dir=ck,
+                    recover=recover,
+                    mesh=int(opt.get("mesh", 0)),
+                    fpstore_dir=opt.get("fpstore_dir"),
+                    mesh_deep=bool(opt.get("mesh_deep", False)),
+                    use_mxu=self.use_mxu,
+                )
+        except resilience.Preempted:
+            self.q.release(jid, note="preempted mid-job")
+            raise
+        except Exception as e:  # graftlint: waive[GL003] last ladder rung: the job fails with the error recorded, the queue keeps draining
+            self._say(f"job {jid} errored: {type(e).__name__}: {e}")
+            self.q.complete(
+                jid,
+                dict(
+                    ok=False, distinct=0, generated=0, depth=0,
+                    level_sizes=[], mxu=None, seconds=None,
+                    violation=f"error: {type(e).__name__}: {e}",
+                ),
+            )
+            self.stats["jobs_failed"] += 1
+            return
+        self.q.complete(jid, summary_public(summary))
+        self.stats["sequential_jobs"] += 1
+        self.stats["jobs_done" if summary["ok"] else "jobs_failed"] += 1
+
+    # -- passes --------------------------------------------------------
+
+    def run_once(self) -> dict:
+        """One scheduler pass: recover, pack, drain what was pending.
+        One queue scan feeds the whole pass (recover + pending +
+        packing) — each helper re-scanning would re-digest every
+        state.json several times per poll."""
+        states = self.q.scan()
+        recovered = self.q.requeue_stale(states)
+        if recovered:
+            self.stats["recovered"] += len(recovered)
+            self._say(f"requeued {len(recovered)} stale job(s): "
+                      f"{recovered}")
+        pending = self.q.pending(states)
+        buckets, singles = self.plan(pending)
+        for key, jobs in buckets:
+            if resilience.preempt_requested():
+                raise resilience.Preempted(None, 0)
+            self._run_bucket(key, jobs)
+        for jid, spec in singles:
+            if resilience.preempt_requested():
+                raise resilience.Preempted(None, 0)
+            if self.q.claim(jid):
+                self._run_one(jid, spec)
+        return dict(self.stats)
+
+    def serve(self, poll: float = 2.0, max_idle: float | None = None):
+        """Poll the queue until preempted (or idle past ``max_idle``).
+
+        Every pass ends in ``sleep(poll)`` — even when jobs stay
+        pending (claims held by another live worker): re-passing
+        without the sleep would spin the scheduler at 100% CPU against
+        a queue it cannot drain."""
+        idle_since = None
+        while True:
+            self.run_once()
+            if self.q.pending():
+                idle_since = None
+            else:
+                if idle_since is None:
+                    idle_since = time.monotonic()
+                if (
+                    max_idle is not None
+                    and time.monotonic() - idle_since > max_idle
+                ):
+                    return dict(self.stats)
+            if resilience.preempt_requested():
+                raise resilience.Preempted(None, 0)
+            time.sleep(poll)

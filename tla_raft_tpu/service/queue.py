@@ -1,0 +1,347 @@
+"""Directory-backed job queue: crash-safe state machine for sweep jobs.
+
+Layout (``root`` is the queue directory, one subdir per job):
+
+    root/jobs/<job_id>/
+        job.json      the immutable job spec (config constants +
+                      run options), committed once at submit
+        state.json    the current state-machine record
+                      {status, attempt, worker, note}; every
+                      transition is a fresh atomic commit
+        lease.json    the claiming worker's lease (pid + heartbeat
+                      serial); REWRITTEN on every heartbeat, atomic
+                      but unmanifested (loss is benign — a missing
+                      lease just reads as stale)
+        ck/           the per-job checkpoint directory: sequential
+                      jobs write the engine delta log here, batched
+                      buckets the bstate snapshot — either way a
+                      SIGKILL'd worker's job RESUMES from it
+        result.json   the final summary (check.py --json schema),
+                      committed exactly once
+
+State machine::
+
+    submitted --claim--> running --complete--> done | failed
+        ^                   |
+        +---requeue (stale lease / preemption / crash)---+
+
+Every JSON record commits through ``resilience.commit_json`` (the
+atomic tmp -> digest -> rename -> MANIFEST.json writer, graftlint
+GL009), so a kill at any byte boundary leaves either the old record or
+the new one, never a torn file; readers go through
+``load_json_verified`` and treat corrupt records as absent.  Claims
+are mutually exclusive via O_CREAT|O_EXCL lease creation; a worker
+that dies holds its claim only until the lease goes stale
+(``lease_ttl`` seconds without a heartbeat), after which any scheduler
+pass requeues the job — attempt count incremented, checkpoint dir
+intact, so the retry resumes instead of restarting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import json
+import os
+import time
+import uuid
+
+from .. import resilience
+from ..config import RaftConfig
+
+JOB = "job.json"
+STATE = "state.json"
+LEASE = "lease.json"
+RESULT = "result.json"
+CKDIR = "ck"
+
+# one schema version for all queue records
+QUEUE_SCHEMA = 1
+
+# job spec fields that map 1:1 onto RaftConfig constants
+_CFG_FIELDS = (
+    "n_servers", "n_vals", "max_election", "max_restart",
+    "symmetry", "use_view", "invariants", "mutations",
+)
+
+STATUSES = ("submitted", "running", "done", "failed")
+
+
+def cfg_to_doc(cfg: RaftConfig) -> dict:
+    d = dataclasses.asdict(cfg)
+    return {k: (list(v) if isinstance(v, tuple) else v)
+            for k, v in d.items() if k in _CFG_FIELDS}
+
+
+def doc_to_cfg(doc: dict) -> RaftConfig:
+    kw = {k: doc[k] for k in _CFG_FIELDS if k in doc}
+    for k in ("invariants", "mutations"):
+        if k in kw:
+            kw[k] = tuple(kw[k])
+    return RaftConfig(**kw)
+
+
+class JobQueue:
+    """The queue API both the client CLI and the daemon go through."""
+
+    def __init__(self, root: str, worker: str | None = None,
+                 lease_ttl: float = 30.0):
+        self.root = root
+        self.jobs_dir = os.path.join(root, "jobs")
+        self.worker = worker or f"w{os.getpid()}"
+        self.lease_ttl = float(lease_ttl)
+
+    # -- paths ---------------------------------------------------------
+
+    def job_dir(self, job_id: str) -> str:
+        return os.path.join(self.jobs_dir, job_id)
+
+    def ck_dir(self, job_id: str) -> str:
+        return os.path.join(self.job_dir(job_id), CKDIR)
+
+    # -- submit --------------------------------------------------------
+
+    def submit(self, cfg: RaftConfig, *, max_depth: int | None = None,
+               options: dict | None = None,
+               job_id: str | None = None) -> str:
+        """Create a job; returns its id.  The spec commits first, the
+        state record second — a crash between the two leaves a spec
+        with no state, which ``scan`` reads as submitted (the state
+        record is re-derivable; the spec is not)."""
+        job_id = job_id or uuid.uuid4().hex[:12]
+        jd = self.job_dir(job_id)
+        if os.path.exists(os.path.join(jd, JOB)):
+            raise FileExistsError(f"job {job_id} already exists")
+        spec = dict(
+            schema=QUEUE_SCHEMA,
+            job_id=job_id,
+            config=cfg_to_doc(cfg),
+            max_depth=max_depth,
+            options=dict(options or {}),
+        )
+        resilience.commit_json(jd, JOB, spec, kind="job")
+        self._set_state(job_id, "submitted", attempt=0)
+        return job_id
+
+    # -- reads ---------------------------------------------------------
+
+    def load_spec(self, job_id: str) -> dict | None:
+        return resilience.load_json_verified(self.job_dir(job_id), JOB)
+
+    def load_state(self, job_id: str) -> dict:
+        jd = self.job_dir(job_id)
+        if not os.path.isdir(jd):
+            # distinguish "never existed" from the submit crash window
+            # below: a typo'd id must error, not read as a live
+            # pending job that tooling then polls forever
+            raise FileNotFoundError(f"no such job: {job_id}")
+        st = resilience.load_json_verified(jd, STATE)
+        if st is None:
+            # spec-without-state (crash inside submit, or torn record):
+            # the job exists, so it is submitted
+            return dict(status="submitted", attempt=0, worker=None)
+        return st
+
+    def load_result(self, job_id: str) -> dict | None:
+        return resilience.load_json_verified(self.job_dir(job_id), RESULT)
+
+    def list_jobs(self) -> list[str]:
+        try:
+            return sorted(
+                d for d in os.listdir(self.jobs_dir)
+                if os.path.isdir(os.path.join(self.jobs_dir, d))
+            )
+        except FileNotFoundError:
+            return []
+
+    def job_cfg(self, job_id: str) -> RaftConfig | None:
+        spec = self.load_spec(job_id)
+        return doc_to_cfg(spec["config"]) if spec else None
+
+    # -- state machine -------------------------------------------------
+
+    def _set_state(self, job_id: str, status: str, *, attempt: int,
+                   worker: str | None = None, note: str | None = None):
+        assert status in STATUSES, status
+        resilience.commit_json(
+            self.job_dir(job_id), STATE,
+            dict(schema=QUEUE_SCHEMA, status=status, attempt=int(attempt),
+                 worker=worker, note=note),
+            kind="jobstate",
+        )
+
+    def _lease_path(self, job_id: str) -> str:
+        return os.path.join(self.job_dir(job_id), LEASE)
+
+    def lease_age(self, job_id: str) -> float | None:
+        """Seconds since the lease's last heartbeat; None = no lease."""
+        try:
+            return time.time() - os.stat(self._lease_path(job_id)).st_mtime
+        except OSError:
+            return None
+
+    def claim(self, job_id: str) -> bool:
+        """Exclusive claim via O_EXCL lease creation.  False = someone
+        else holds a live lease (or won the race)."""
+        st = self.load_state(job_id)
+        if st["status"] not in ("submitted",):
+            return False
+        path = self._lease_path(job_id)
+        age = self.lease_age(job_id)
+        if (
+            age is not None and age <= self.lease_ttl
+            and not self._lease_dead(job_id)
+        ):
+            return False
+        if age is not None:
+            # stale takeover must be rename-then-create: the rename of
+            # the stale inode has exactly ONE winner, so a racing
+            # claimant can never unlink a FRESH lease another worker
+            # just created between our staleness check and our sweep
+            # (the unlink-based sweep's TOCTOU)
+            stale = path + f".stale-{uuid.uuid4().hex[:8]}"
+            try:
+                os.rename(path, stale)
+            except OSError:
+                return False  # another worker swept or replaced it
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except OSError as e:
+            if e.errno == errno.EEXIST:
+                return False
+            raise
+        with os.fdopen(fd, "w") as fh:
+            # real JSON (escaped worker name): _lease_dead parses this;
+            # a kill mid-write leaves an unparsable lease, which reads
+            # as pid-unknown and falls back to the TTL — still safe
+            json.dump(
+                dict(worker=self.worker, pid=os.getpid(), beats=0), fh
+            )
+            fh.write("\n")
+        self._set_state(
+            job_id, "running", attempt=int(st.get("attempt", 0)) + 1,
+            worker=self.worker,
+        )
+        return True
+
+    def heartbeat(self, job_id: str, beats: int = 0) -> None:
+        """Refresh the lease mtime (atomic rewrite, unmanifested)."""
+        resilience.commit_json(
+            self.job_dir(job_id), LEASE,
+            dict(worker=self.worker, pid=os.getpid(), beats=int(beats)),
+            kind="lease", manifest=False,
+        )
+
+    def _lease_dead(self, job_id: str) -> bool:
+        """True when the lease's recorded pid no longer exists on this
+        host — a crashed worker's claim is released IMMEDIATELY instead
+        of waiting out the TTL (a HUNG worker, pid alive, still ages
+        out via the TTL; cross-host leases carry no local pid and fall
+        back to the TTL too)."""
+        try:
+            with open(self._lease_path(job_id), encoding="utf-8") as fh:
+                pid = json.load(fh).get("pid")
+        except (OSError, ValueError):
+            return False  # torn heartbeat: age decides
+        if not isinstance(pid, int):
+            return False
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return True
+        except PermissionError:
+            return False
+        return False
+
+    def complete(self, job_id: str, summary: dict) -> None:
+        """Commit the result, flip the state, release the lease —
+        in that order, so a crash can duplicate work but never lose a
+        committed verdict."""
+        st = self.load_state(job_id)
+        resilience.commit_json(
+            self.job_dir(job_id), RESULT,
+            dict(schema=QUEUE_SCHEMA, **summary),
+            kind="result",
+        )
+        self._set_state(
+            job_id, "done" if summary.get("ok") else "failed",
+            attempt=int(st.get("attempt", 0)), worker=self.worker,
+            note=summary.get("violation"),
+        )
+        try:
+            os.unlink(self._lease_path(job_id))
+        except OSError:
+            pass
+
+    def release(self, job_id: str, note: str | None = None) -> None:
+        """Return a claimed job to the queue (preemption / shutdown)."""
+        st = self.load_state(job_id)
+        self._set_state(
+            job_id, "submitted", attempt=int(st.get("attempt", 0)),
+            note=note,
+        )
+        try:
+            os.unlink(self._lease_path(job_id))
+        except OSError:
+            pass
+
+    def fail_unreadable(self, job_id: str, note: str) -> None:
+        """Surface a job whose spec cannot be read (a submit that died
+        inside the job.json commit window, or a torn spec) as FAILED —
+        otherwise it would sit pending forever and the scheduler could
+        never drain the queue to idle."""
+        st = self.load_state(job_id)
+        self._set_state(
+            job_id, "failed", attempt=int(st.get("attempt", 0)),
+            note=note,
+        )
+
+    def scan(self) -> dict:
+        """{job_id: state} in one pass — the per-pass digest-verified
+        read each caller shares, instead of every helper re-walking
+        and re-hashing the whole queue (at 1k jobs an idle poll was
+        3-4 full scans per pass)."""
+        return {jid: self.load_state(jid) for jid in self.list_jobs()}
+
+    def requeue_stale(self, states: dict | None = None) -> list[str]:
+        """Requeue every running job whose lease is stale or missing —
+        the crash-recovery sweep each scheduler pass runs first.  The
+        job's checkpoint dir is left intact: the retry RESUMES.
+        Mutates ``states`` (when given) to reflect the requeues."""
+        out = []
+        states = self.scan() if states is None else states
+        for jid, st in states.items():
+            if st["status"] != "running":
+                continue
+            age = self.lease_age(jid)
+            if age is None or age > self.lease_ttl or self._lease_dead(jid):
+                self._set_state(
+                    jid, "submitted", attempt=int(st.get("attempt", 0)),
+                    note=f"requeued (stale lease, worker "
+                         f"{st.get('worker')})",
+                )
+                try:
+                    os.unlink(self._lease_path(jid))
+                except OSError:
+                    pass
+                states[jid] = dict(st, status="submitted")
+                out.append(jid)
+        return out
+
+    def pending(self, states: dict | None = None) -> list[str]:
+        """Jobs ready to claim (after the stale-lease sweep)."""
+        states = self.scan() if states is None else states
+        return [
+            jid for jid, st in states.items()
+            if st["status"] == "submitted"
+        ]
+
+    def counts(self) -> dict:
+        c = dict.fromkeys(STATUSES, 0)
+        for jid in self.list_jobs():
+            c[self.load_state(jid)["status"]] += 1
+        return c
