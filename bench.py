@@ -37,7 +37,9 @@ BENCH_VALS / BENCH_MAX_ELECTION (scale dials, BASELINE.md configs 3-5),
 BENCH_GOLD_DEPTH (oracle prefix depth), RAFT_CFG, BENCH_HASHSTORE (0 =
 sort-path A/B), BENCH_PIPELINE (0 = serial-chain A/B) /
 BENCH_PIPELINE_WINDOW (in-flight fetch groups, default 2), BENCH_MXU
-(0 = legacy per-lane expand A/B), BENCH_SERVICE (1 = the sweep-service
+(0 = legacy per-lane expand A/B), BENCH_AUDIT (1 = integrity audit at
+BENCH_AUDIT_N rows/level, default 64 — overhead A/B, single-device
+arm), BENCH_SERVICE (1 = the sweep-service
 jobs/hour A/B on the synthetic queue instead — see _bench_service).
 """
 
@@ -559,6 +561,16 @@ def main():
         # expand"); counts are bit-identical either way, so the parity
         # gates hold in both arms
         use_mxu = bool(int(os.environ.get("BENCH_MXU", "1")))
+        # BENCH_AUDIT=1 arms the end-to-end integrity audit at
+        # BENCH_AUDIT_N rows/level (default 64) — the A/B lever for the
+        # audit-mode overhead record (docs/ROBUSTNESS.md; target < 5%
+        # at --audit 64).  Counts are bit-identical either way (the
+        # audit only READS; it rewinds solely on real corruption).
+        # Single-device engine only; the mesh arms ignore it.
+        audit_n = (
+            int(os.environ.get("BENCH_AUDIT_N", "64"))
+            if int(os.environ.get("BENCH_AUDIT", "0")) else 0
+        )
     except Exception as e:
         _emit_failure("bench_setup", e)
         return 1
@@ -594,7 +606,7 @@ def main():
             chk1 = JaxChecker(
                 cfg, chunk=chunk, progress=progress, use_hashstore=use_hs,
                 pipeline=use_pipe, pipeline_window=pipe_window,
-                use_mxu=use_mxu,
+                use_mxu=use_mxu, audit=audit_n,
             )
             res = chk1.run(max_depth=max_depth)
             pipe_on, pipe_win = chk1.pipeline, chk1.pipeline_window
@@ -700,6 +712,7 @@ def main():
         "pipeline": pipe_on,
         "pipeline_window": pipe_win if pipe_on else 0,
         "mxu": use_mxu,
+        "audit": audit_n if not mesh_n else 0,
     }
     if full_golden is not None:
         out["golden_full"] = {
@@ -748,6 +761,7 @@ def main():
             "pipeline": out["pipeline"],
             "pipeline_window": out["pipeline_window"],
             "mxu": out["mxu"],
+            "audit": out["audit"],
         }
         for k in ("mesh", "mesh_deep", "peak_dev_rows", "exchange"):
             if k in out:

@@ -1,0 +1,613 @@
+"""Elastic mesh recovery + silent-corruption defense (ISSUE 10).
+
+Three pillars, each driven by the deterministic fault plan on the
+virtual CPU mesh:
+
+* **Elastic resume** — a D-device sweep killed mid-run resumes on
+  D' != D devices with bit-identical distinct/generated/depth/
+  level_sizes (both directions, plain and deep mesh): the mdelta
+  replay tracks per-record geometry, the owner remap re-shards the
+  frontier by fp % D', and the slabs/stores rehash into the new
+  partition.
+* **Watchdog** — an injected hung dispatch (``device.hang``) becomes a
+  clean resumable exit 75 instead of an infinite stall; an injected
+  device loss (``device.lost``) is classified and leaves a resumable
+  log.
+* **Integrity audits** — an injected frontier bit flip
+  (``tensor.flip``) is caught by ``--audit``, the level rewinds to the
+  last committed checkpoint and the run converges to correct counts;
+  a reproducible flip fail-stops after the strike budget.
+
+Plus the service satellite: poison-job quarantine (a job whose worker
+dies ``max_attempts`` times moves to ``failed/`` with its accumulated
+failure log) and the jittered ``with_retry`` backoff.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from tla_raft_tpu import resilience
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.engine import JaxChecker
+from tla_raft_tpu.oracle import OracleChecker
+from tla_raft_tpu.parallel import ShardedChecker, make_mesh
+from tla_raft_tpu.resilience import elastic, faults, integrity
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+S2 = RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1)
+
+CFG_2111 = textwrap.dedent(
+    """
+    CONSTANTS
+        MaxTerm = 3
+        MaxRestart = 1
+        MaxElection = 1
+        Follower = Follower
+        Candidate = Candidate
+        Leader = Leader
+        None = None
+        VoteReq = VoteReq
+        VoteResp = VoteResp
+        AppendReq = AppendReq
+        AppendResp = AppendResp
+        s1 = s1
+        s2 = s2
+        Servers = {s1, s2}
+        v1 = v1
+        Vals = {v1}
+
+    SYMMETRY symmServers
+    VIEW view
+
+    INIT Init
+    NEXT Next
+
+    INVARIANT
+    Inv
+    """
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    faults.reset()
+    resilience.clear_preempt()
+    elastic.install_watchdog(None)
+
+
+@pytest.fixture(scope="module")
+def golden_s2():
+    return OracleChecker(S2).run()
+
+
+def _cfg_file(tmp_path):
+    p = tmp_path / "Tiny.cfg"
+    p.write_text(CFG_2111)
+    return str(p)
+
+
+def _run_cli(args, fault=None, devices=1, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices}"
+    )
+    if fault is not None:
+        env["TLA_RAFT_FAULT"] = fault
+    else:
+        env.pop("TLA_RAFT_FAULT", None)
+    return subprocess.run(
+        [sys.executable, "-m", "tla_raft_tpu.check", *args],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def _json_line(proc):
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    raise AssertionError(
+        f"no JSON summary in output:\n{proc.stdout}\n{proc.stderr}"
+    )
+
+
+def _assert_golden(got, golden):
+    assert got.ok
+    assert got.distinct == golden.distinct
+    assert got.generated == golden.generated
+    assert got.depth == golden.depth
+    assert list(got.level_sizes) == list(golden.level_sizes)
+
+
+# -- pillar 1: elastic resume (D -> D' re-sharding) ------------------------
+
+def test_elastic_deep_kill_resume_4_to_2_via_cli(tmp_path, golden_s2):
+    """The acceptance row: a 4-device mesh-deep sweep SIGKILLed
+    mid-level resumes on a 2-device mesh — owner remap + slab rehash —
+    with bit-identical counts.  The resume passes ``--mesh 4`` against
+    2 visible devices, so the elastic clamp (effective_mesh) is on the
+    hook too: exactly the relaunch-after-device-loss shape."""
+    cfg = _cfg_file(tmp_path)
+    ck = str(tmp_path / "ck")
+    base = [
+        "--config", cfg, "--chunk", "64", "--checkpoint-dir", ck,
+        "--mesh-deep", "--seg-rows", "8", "--cap-x", "256",
+        "--log", "-", "--json",
+    ]
+    first = _run_cli(
+        base + ["--mesh", "4", "--fpstore-dir", str(tmp_path / "f1")],
+        fault="mdelta.commit:kill@3", devices=4,
+    )
+    assert first.returncode not in (0, 1, 2, 3, 4), (
+        f"kill fault did not kill the run:\n{first.stdout}"
+    )
+    assert glob.glob(os.path.join(ck, "mdelta_*.npz"))
+    rec = _run_cli(
+        base + ["--mesh", "4", "--fpstore-dir", str(tmp_path / "f2"),
+                "--recover", ck],
+        devices=2,
+    )
+    assert rec.returncode == 0, rec.stdout + rec.stderr
+    assert "[elastic]" in rec.stdout + rec.stderr
+    got = _json_line(rec)
+    assert got["ok"]
+    assert got["distinct"] == golden_s2.distinct
+    assert got["generated"] == golden_s2.generated
+    assert got["depth"] == golden_s2.depth
+    assert got["level_sizes"] == list(golden_s2.level_sizes)
+    # straggler skew metrics ride the summary on mesh runs
+    assert got["straggler"]["levels"] > 0
+    assert len(got["straggler"]["per_owner_rows"]) == 2
+    assert not glob.glob(os.path.join(ck, ".tmp_*"))
+
+
+def test_elastic_deep_resume_2_to_4_and_mixed_chain(tmp_path, golden_s2):
+    """The opposite direction in-process (2 -> 4), then a full replay
+    of the resulting MIXED-geometry chain (2-device prefix + rewritten
+    boundary + 4-device tail) on an 8-device mesh: every record's own
+    geometry drives the replay, so any mesh can adopt any log."""
+    if len(jax.devices()) < 8:
+        pytest.skip("not enough virtual devices")
+    ck = str(tmp_path / "ck")
+    half = ShardedChecker(
+        S2, make_mesh(2), cap_x=256, deep=True, seg_rows=8,
+        host_store_dir=str(tmp_path / "f1"),
+    ).run(max_depth=5, checkpoint_dir=ck)
+    assert half.depth == 5
+    res = ShardedChecker(
+        S2, make_mesh(4), cap_x=256, deep=True, seg_rows=8,
+        host_store_dir=str(tmp_path / "f2"),
+    ).run(resume_from=ck, checkpoint_dir=ck)
+    _assert_golden(res, golden_s2)
+    res8 = ShardedChecker(
+        S2, make_mesh(8), cap_x=256, deep=True, seg_rows=8,
+        host_store_dir=str(tmp_path / "f3"),
+    ).run(resume_from=ck)
+    _assert_golden(res8, golden_s2)
+
+
+def test_elastic_plain_mesh_both_directions(tmp_path, golden_s2):
+    """Plain (non-deep) mesh elastic resume, 4 -> 2 and 2 -> 4: the
+    device-resident visited slabs rehash into the new fp %% D'
+    partition during the replay rebuild."""
+    if len(jax.devices()) < 4:
+        pytest.skip("not enough virtual devices")
+    for d_from, d_to in ((4, 2), (2, 4)):
+        ck = str(tmp_path / f"ck_{d_from}_{d_to}")
+        ShardedChecker(S2, make_mesh(d_from), cap_x=256).run(
+            max_depth=5, checkpoint_dir=ck
+        )
+        res = ShardedChecker(S2, make_mesh(d_to), cap_x=256).run(
+            resume_from=ck, checkpoint_dir=ck
+        )
+        _assert_golden(res, golden_s2)
+
+
+def test_legacy_run_fp_migrates_on_resume(tmp_path, golden_s2):
+    """Pre-elastic mesh checkpoints pinned the device count into the
+    manifest run fingerprint; resuming one must MIGRATE the manifest
+    to the D-free form (same-D and cross-D), not refuse with
+    RunMismatch."""
+    if len(jax.devices()) < 4:
+        pytest.skip("not enough virtual devices")
+    from tla_raft_tpu.resilience import manifest as manifest_mod
+
+    ck = str(tmp_path / "ck")
+    ShardedChecker(S2, make_mesh(4), cap_x=256).run(
+        max_depth=5, checkpoint_dir=ck
+    )
+    # rewrite the manifest binding to the OLD (D-pinned) digest form
+    legacy_fp = resilience.run_config_fingerprint(
+        S2, log="mdelta", D=4, exchange="all_to_all", canon="late"
+    )
+    m = manifest_mod.Manifest.load(ck)
+    new_fp = m.run_fp
+    assert new_fp != legacy_fp
+    m.run_fp = legacy_fp
+    m.commit()
+    # cross-D resume of the "legacy" directory: migrates + converges
+    res = ShardedChecker(S2, make_mesh(2), cap_x=256).run(
+        resume_from=ck, checkpoint_dir=ck
+    )
+    _assert_golden(res, golden_s2)
+    assert manifest_mod.Manifest.load(ck).run_fp == new_fp
+    # a genuinely different config still refuses
+    other = RaftConfig(n_servers=2, n_vals=1, max_election=2,
+                       max_restart=1)
+    with pytest.raises(resilience.RunMismatch):
+        ShardedChecker(other, make_mesh(2), cap_x=256).run(
+            resume_from=ck
+        )
+
+
+def test_owner_rebalance_math():
+    """The remap helper alone: every live row lands in its owner's
+    block prefix, in stable source order, for any D."""
+    rng = np.random.RandomState(7)
+    fp = rng.randint(0, 2**63, size=64).astype(np.uint64)
+    valid = rng.rand(64) < 0.7
+    for D in (1, 2, 3, 8):
+        perm, counts, cap = elastic.owner_rebalance(fp, valid, D)
+        assert counts.sum() == valid.sum()
+        assert cap >= counts.max()
+        for o in range(D):
+            rows = perm[o * cap: o * cap + counts[o]]
+            assert (rows >= 0).all()
+            assert (fp[rows] % np.uint64(D) == o).all()
+            assert (valid[rows]).all()
+            # stable: source order preserved within an owner block
+            assert (np.diff(rows) > 0).all()
+        assert (perm[perm >= 0].size == valid.sum())
+
+
+# -- pillar 2: watchdog + device loss --------------------------------------
+
+def test_watchdog_hang_becomes_exit75_then_resume(tmp_path, golden_s2):
+    """An injected hung dispatch is converted by the watchdog into a
+    resumable exit 75 (cooperative first, hard exit if wedged); the
+    follow-up run converges to the exact fixpoint."""
+    cfg = _cfg_file(tmp_path)
+    ck = str(tmp_path / "ck")
+    base = ["--config", cfg, "--chunk", "64", "--checkpoint-dir", ck,
+            "--log", "-", "--json"]
+    first = _run_cli(
+        base + ["--watchdog", "8"], fault="device.hang:hang@4",
+        timeout=300,
+    )
+    assert first.returncode == 75, first.stdout + first.stderr
+    assert "watchdog" in (first.stdout + first.stderr).lower()
+    resume = (
+        ["--recover", ck]
+        if glob.glob(os.path.join(ck, "delta_*.npz")) else []
+    )
+    rec = _run_cli(base + resume)
+    assert rec.returncode == 0, rec.stdout + rec.stderr
+    got = _json_line(rec)
+    assert got["distinct"] == golden_s2.distinct
+    assert got["level_sizes"] == list(golden_s2.level_sizes)
+
+
+def test_watchdog_mechanics_inprocess():
+    """Arm/touch/disarm and the expiry ladder, with the hard exit
+    stubbed: expiry requests cooperative preemption, then calls the
+    hard hook when nothing releases the watchdog."""
+    fired = []
+    wd = elastic.Watchdog(0.2, on_hard_timeout=lambda: fired.append(1))
+    try:
+        # a disarmed level never fires
+        wd.arm("level A")
+        wd.disarm()
+        time.sleep(0.5)
+        assert wd.fired == 0 and not resilience.preempt_requested()
+        # an armed, never-released level fires: preempt + hard hook
+        wd.arm("level B")
+        deadline = time.monotonic() + 10
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert wd.fired == 1
+        assert resilience.preempt_requested()
+        assert fired == [1]
+    finally:
+        wd.cancel()
+        resilience.clear_preempt()
+
+
+def test_device_lost_classified_and_resumable(tmp_path, golden_s2):
+    """An injected device loss raises DeviceLost (classified by
+    elastic.is_device_loss), leaves the committed log intact, and the
+    resumed run — here on the SAME width — converges exactly."""
+    if len(jax.devices()) < 2:
+        pytest.skip("not enough virtual devices")
+    ck = str(tmp_path / "ck")
+    faults.install("device.lost:lost@4")
+    with pytest.raises(resilience.DeviceLost) as ei:
+        ShardedChecker(S2, make_mesh(2), cap_x=256).run(
+            checkpoint_dir=ck
+        )
+    assert elastic.is_device_loss(ei.value)
+    assert not elastic.is_device_loss(ValueError("boom"))
+    # a bare RuntimeError must never classify, even with a marker in
+    # its text — only the XLA/PJRT runtime exception types count
+    assert not elastic.is_device_loss(RuntimeError("deadline exceeded"))
+    assert not elastic.is_device_loss(
+        RuntimeError("INTERNAL: failed to serialize")
+    )
+    faults.reset()
+    assert len(glob.glob(os.path.join(ck, "mdelta_*.npz"))) == 3
+    res = ShardedChecker(S2, make_mesh(2), cap_x=256).run(
+        resume_from=ck, checkpoint_dir=ck
+    )
+    _assert_golden(res, golden_s2)
+
+
+# -- pillar 3: integrity audits --------------------------------------------
+
+def test_tensor_flip_caught_by_audit_and_rewound(tmp_path, golden_s2):
+    """The acceptance row: an injected frontier bit flip is caught by
+    the sampled recomputation audit, the level is quarantined, the run
+    rewinds to the last committed checkpoint and converges to the
+    exact fixpoint — one strike recorded, one rewind."""
+    ck = str(tmp_path / "ck")
+    faults.install("tensor.flip:flip@4")
+    chk = JaxChecker(S2, chunk=64, audit=8)
+    res = chk.run(checkpoint_dir=ck)
+    _assert_golden(res, golden_s2)
+    assert chk.audit_stats["mismatches"] >= 1
+    assert chk.audit_stats["rewinds"] == 1
+    assert chk.audit_stats["levels"] > golden_s2.depth  # re-audited
+
+
+def test_audit_clean_run_zero_overhead_counters(tmp_path, golden_s2):
+    """No fault: the audit verifies every level and never rewinds."""
+    chk = JaxChecker(S2, chunk=64, audit=4)
+    res = chk.run(checkpoint_dir=str(tmp_path / "ck"))
+    _assert_golden(res, golden_s2)
+    assert chk.audit_stats["mismatches"] == 0
+    assert chk.audit_stats["rewinds"] == 0
+    assert chk.audit_stats["levels"] == golden_s2.depth
+
+
+def test_reproducible_flip_fail_stops(tmp_path):
+    """A flip that reproduces AT THE SAME LEVEL after every rewind
+    exhausts the strike budget and fail-stops with AuditFailStop
+    (CLI exit 4)."""
+    ck = str(tmp_path / "ck")
+    # the site counter is per-process and counts LEVELS; after a rewind
+    # the loop keeps counting, so consecutive triggers re-corrupt the
+    # SAME re-expanded level every time — deterministic corruption
+    faults.install(
+        "tensor.flip:flip@4;tensor.flip:flip@5;tensor.flip:flip@6;"
+        "tensor.flip:flip@7;tensor.flip:flip@8;tensor.flip:flip@9"
+    )
+    chk = JaxChecker(S2, chunk=64, audit=8, audit_retries=3)
+    with pytest.raises(integrity.AuditFailStop):
+        chk.run(checkpoint_dir=ck)
+    assert chk.audit_stats["rewinds"] == 2  # strikes 1, 2, then stop
+
+
+def test_independent_transient_flips_do_not_fail_stop(tmp_path, golden_s2):
+    """Strikes count per mismatch LEVEL: transient flips at different
+    levels rewind independently and the run still converges — only
+    same-level reproduction is 'deterministic corruption'."""
+    ck = str(tmp_path / "ck")
+    # three one-shot flips at three DIFFERENT levels (the rewind resets
+    # each one: fire counts 4 -> level 4's redo passes at fire 5... so
+    # space the triggers apart so each fires at a fresh level)
+    faults.install(
+        "tensor.flip:flip@4;tensor.flip:flip@7;tensor.flip:flip@10"
+    )
+    chk = JaxChecker(S2, chunk=64, audit=8, audit_retries=2)
+    res = chk.run(checkpoint_dir=ck)
+    _assert_golden(res, golden_s2)
+    assert chk.audit_stats["rewinds"] == 3
+    assert chk.audit_stats["mismatches"] >= 3
+
+
+def test_audit_indices_deterministic_and_cover_row0():
+    assert integrity.audit_indices(9, 8).tolist() == list(range(8))
+    assert integrity.audit_indices(3, 8).tolist() == [0, 1, 2]
+    assert integrity.audit_indices(0, 8).size == 0
+    big = integrity.audit_indices(10**6, 64)
+    assert big[0] == 0 and big.size == 64
+    assert (integrity.audit_indices(10**6, 64) == big).all()
+
+
+def test_conservation_checks_raise():
+    integrity.reconcile("x", 5, 5)
+    with pytest.raises(integrity.IntegrityError, match="conservation"):
+        integrity.reconcile("x", 5, 4, level=3)
+    integrity.occupancy_check("slab", 7, 7)
+    with pytest.raises(integrity.IntegrityError, match="occupancy"):
+        integrity.occupancy_check("slab", 7, 8)
+
+
+def test_skew_meter_summary():
+    m = integrity.SkewMeter(4)
+    m.note(1, rows=[1, 1, 1, 5], seconds=[0.1, 0.1, 0.1, 0.9])
+    m.note(2, rows=[2, 2, 2, 2])
+    s = m.summary()
+    assert s["levels"] == 2
+    assert s["per_owner_rows"] == [3, 3, 3, 7]
+    assert s["worst_owner"] == 3
+    assert s["peak_row_skew"] > 2
+    assert s["peak_time_skew"] > 2
+    # worst owners are tracked PER METRIC: a later time peak on a
+    # different owner must not relabel the row peak's owner
+    m2 = integrity.SkewMeter(2)
+    m2.note(1, rows=[9, 1], seconds=[0.1, 0.1])
+    m2.note(2, rows=[1, 1], seconds=[0.1, 0.9])
+    s2 = m2.summary()
+    assert s2["worst_owner"] == 0
+    assert s2["worst_owner_time"] == 1
+
+
+# -- satellites: poison-job quarantine + jittered retry --------------------
+
+def _dead_lease(q, jid):
+    lp = q._lease_path(jid)
+    with open(lp, "w") as fh:
+        json.dump(dict(worker="ghost", pid=1 << 22, beats=0), fh)
+    os.utime(lp, (0, 0))
+
+
+def test_poison_job_quarantine(tmp_path):
+    """A job whose worker dies max_attempts times moves to failed/
+    with the accumulated failure log instead of requeueing forever."""
+    from tla_raft_tpu.service.queue import JobQueue
+
+    root = str(tmp_path / "q")
+    q = JobQueue(root, lease_ttl=0.0, max_attempts=3)
+    jid = q.submit(S2)
+    for death in range(3):
+        assert q.load_state(jid)["status"] == "submitted"
+        assert q.claim(jid)
+        _dead_lease(q, jid)
+        requeued = q.requeue_stale()
+        if death < 2:
+            assert requeued == [jid]
+            assert q.poisoned_last == []
+        else:
+            assert requeued == []
+            assert q.poisoned_last == [jid]
+    st = q.load_state(jid)
+    assert st["status"] == "failed"
+    assert len(st["failures"]) == 3
+    assert all("worker died" in f["note"] for f in st["failures"])
+    # moved wholesale to failed/, out of the pending scan
+    assert os.path.isdir(os.path.join(root, "failed", jid))
+    assert not os.path.isdir(os.path.join(root, "jobs", jid))
+    assert q.pending() == []
+    # status/result reads follow the move
+    res = q.load_result(jid)
+    assert res is not None and not res["ok"]
+    assert "poisoned" in res["violation"]
+    assert len(res["failures"]) == 3
+    assert q.counts()["failed"] == 1
+
+
+def test_poisoned_job_does_not_block_scheduler(tmp_path):
+    """The scheduler's sweep counts the poisoning and the queue still
+    drains to idle (the poisoned job no longer reads as pending)."""
+    from tla_raft_tpu.service.daemon import Scheduler
+    from tla_raft_tpu.service.queue import JobQueue
+
+    root = str(tmp_path / "q")
+    q = JobQueue(root, lease_ttl=0.0, max_attempts=1)
+    jid = q.submit(S2, options=dict(backend="oracle"))
+    assert q.claim(jid)
+    _dead_lease(q, jid)
+    sched = Scheduler(q, batch=False)
+    sched.run_once()
+    assert sched.stats["poisoned"] == 1
+    assert q.load_state(jid)["status"] == "failed"
+    assert q.pending() == []
+
+
+def test_with_retry_backoff_and_jitter(monkeypatch):
+    """Exponential backoff with jitter: delays grow ~2x and carry the
+    [0.5, 1.5) jitter factor; the last failure propagates."""
+    delays = []
+    monkeypatch.setattr(time, "sleep", lambda s: delays.append(s))
+    import tla_raft_tpu.resilience.recover as recover_mod
+
+    monkeypatch.setattr(recover_mod.time, "sleep",
+                        lambda s: delays.append(s))
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert resilience.with_retry(
+        flaky, "test", attempts=4, base_delay=0.1
+    ) == "ok"
+    assert len(delays) == 2
+    assert 0.05 <= delays[0] < 0.15  # 0.1 * [0.5, 1.5)
+    assert 0.10 <= delays[1] < 0.30  # 0.2 * [0.5, 1.5)
+
+    def always():
+        raise resilience.FaultError("nope")
+
+    delays.clear()
+    with pytest.raises(resilience.FaultError):
+        resilience.with_retry(always, "test", attempts=3,
+                              base_delay=0.01)
+    assert len(delays) == 2  # no sleep after the final attempt
+
+
+def test_lease_renewal_survives_transient_fs_error(tmp_path):
+    """The queue's heartbeat rides with_retry: an injected transient
+    failure at the lease writer site does not drop a healthy lease."""
+    from tla_raft_tpu.service.queue import JobQueue
+
+    q = JobQueue(str(tmp_path / "q"), lease_ttl=30.0)
+    jid = q.submit(S2)
+    assert q.claim(jid)
+    faults.install("lease.tmp:fail@1")
+    q.heartbeat(jid)  # first write fails, the retry lands
+    faults.reset()
+    assert q.lease_age(jid) is not None
+    assert q.lease_age(jid) < 5.0
+
+
+def test_exchange_stream_verify_catches_corruption():
+    """The deep exchange's packed fp stream decodes with an integrity
+    check: a corrupted (duplicate-class) delta breaks the strictly-
+    ascending contract and raises before any store insert."""
+    import jax.numpy as jnp
+
+    from tla_raft_tpu.parallel.exchange import (
+        pack_fp_deltas, unpack_fp_deltas,
+    )
+
+    fps = np.sort(
+        np.random.RandomState(0).randint(1, 2**62, 100).astype(np.uint64)
+    )
+    padded = np.full(128, np.uint64(0xFFFFFFFFFFFFFFFF))
+    padded[:100] = fps
+    st, nib, _total = pack_fp_deltas(jnp.asarray(padded), jnp.asarray(100))
+    out = unpack_fp_deltas(np.asarray(st), np.asarray(nib), 100,
+                           verify=True)
+    assert (out == fps).all()
+    nibh = np.asarray(nib)
+    nb = np.empty(2 * len(nibh), np.int64)
+    nb[0::2] = nibh & 0xF
+    nb[1::2] = nibh >> 4
+    nb = nb[:100]
+    off = np.cumsum(nb) - nb
+    stc = np.asarray(st).copy()
+    stc[off[5]: off[5] + nb[5]] = 0  # delta -> 0: a duplicate entry
+    with pytest.raises(integrity.IntegrityError, match="exchange stream"):
+        unpack_fp_deltas(stc, nibh, 100, verify=True)
+
+
+# -- fault-plan grammar for the new sites ----------------------------------
+
+def test_new_fault_sites_registered():
+    p = faults.FaultPlan(
+        "device.lost:lost@2; device.hang:hang; tensor.flip:flip@3"
+    )
+    assert ("device.lost", "lost", 2) in p.triggers
+    assert ("device.hang", "hang", 1) in p.triggers
+    assert ("tensor.flip", "flip", 3) in p.triggers
+    # fire_flag only reports flips; other sites stay callable
+    faults.install("tensor.flip:flip@2")
+    assert resilience.fault_flag("tensor.flip") is False
+    assert resilience.fault_flag("tensor.flip") is True
+    assert resilience.fault_flag("tensor.flip") is False
+    faults.reset()

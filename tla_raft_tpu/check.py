@@ -175,7 +175,10 @@ def _supervise(args, raw_argv) -> int:
         ):
             cmd += ["--recover", args.checkpoint_dir]
         rc = subprocess.call(cmd)
-        if rc in (0, 1, 2, 3):
+        # terminal: clean sweep (0), model violation (1), usage error
+        # (2), sanitizer findings (3), integrity fail-stop (4 —
+        # deterministic corruption; relaunching cannot outrun it)
+        if rc in (0, 1, 2, 3, 4):
             return rc
         attempts += 1
         if attempts > args.supervise:
@@ -210,7 +213,7 @@ def summarize(res, chk=None, seconds: float | None = None) -> dict:
     payloads (the raw result/checker objects) and are stripped by
     :func:`summary_public` before anything is serialized.
     """
-    return dict(
+    out = dict(
         ok=res.ok,
         distinct=res.distinct,
         generated=res.generated,
@@ -222,6 +225,15 @@ def summarize(res, chk=None, seconds: float | None = None) -> dict:
         seconds=round(seconds, 3) if seconds is not None else None,
         violation=res.violation[0] if res.violation else None,
     )
+    # integrity-audit counters (single-device --audit runs only)
+    aud = getattr(chk, "audit_stats", None)
+    if aud and aud.get("levels"):
+        out["audit"] = dict(aud)
+    # per-owner straggler/skew metrics (mesh runs)
+    skew = getattr(chk, "skew", None)
+    if skew is not None and getattr(skew, "levels", 0):
+        out["straggler"] = skew.summary()
+    return out
 
 
 def summary_public(summary: dict) -> dict:
@@ -252,6 +264,9 @@ def run_check(
     pipeline_window: int | None = None,
     prewarm: bool | None = None,
     use_mxu: bool | None = None,
+    audit: int = 0,
+    audit_retries: int = 3,
+    watchdog: float = 0.0,
     progress=None,
     out=None,
     install_signals: bool = False,
@@ -313,6 +328,32 @@ def run_check(
 
         print(f"Devices: {jax.devices()}", file=out)
 
+        # per-level hang watchdog (resilience/elastic.py): --watchdog S
+        # arms every level with max(S, 8 * last level seconds); a hung
+        # dispatch becomes a clean resumable exit 75 instead of an
+        # infinite stall
+        wd = None
+        if watchdog and watchdog > 0 and backend != "oracle":
+            wd = resilience.elastic.Watchdog(float(watchdog))
+            resilience.elastic.install_watchdog(wd)
+            print(f"Watchdog: armed (floor {float(watchdog)}s/level)",
+                  file=out)
+
+        def wd_teardown():
+            # on EVERY exit (Preempted, device loss, IntegrityError
+            # propagate to the caller by contract): a leaked watchdog
+            # thread would park forever and a stale global would
+            # swallow the next run's heartbeats
+            if wd is not None:
+                wd.cancel()
+                resilience.elastic.install_watchdog(None)
+
+        # elastic relaunch: a recovery run on a shrunken fleet (device
+        # loss) re-shards onto the surviving devices instead of
+        # refusing to start; fresh runs keep the strict mesh check
+        if mesh and recover:
+            mesh = resilience.elastic.effective_mesh(mesh, out)
+
         host_store = None  # single-device external store (mesh has its own)
         if fpstore_dir and not mesh:
             from .native import HostFPStore
@@ -347,14 +388,25 @@ def run_check(
                 pipeline=pipeline,
                 pipeline_window=pipeline_window,
                 use_mxu=use_mxu,
+                watchdog=wd,
             )
-            with sanctx:
-                res = chk.run(
-                    max_depth=max_depth,
-                    checkpoint_dir=checkpoint_dir,
-                    checkpoint_every=checkpoint_every,
-                    resume_from=recover,
+            if audit:
+                print(
+                    "--audit applies to the single-device engine; mesh "
+                    "runs keep the always-on conservation checks "
+                    "(count reconciliation, store occupancy)",
+                    file=out,
                 )
+            try:
+                with sanctx:
+                    res = chk.run(
+                        max_depth=max_depth,
+                        checkpoint_dir=checkpoint_dir,
+                        checkpoint_every=checkpoint_every,
+                        resume_from=recover,
+                    )
+            finally:
+                wd_teardown()
             if mesh_deep and chk.meter.levels:
                 # run-summary exchange ledger: the sieve+compress bytes
                 # vs what the uncompressed exchange would have moved
@@ -387,13 +439,26 @@ def run_check(
                     pipeline_window=pipeline_window,
                     use_mxu=use_mxu,
                     prewarm=prewarm,
+                    audit=audit,
+                    audit_retries=audit_retries,
+                    watchdog=wd,
                 )
-                res = chk.run(
-                    max_depth=max_depth,
-                    checkpoint_dir=checkpoint_dir,
-                    checkpoint_every=checkpoint_every,
-                    resume_from=recover,
-                )
+                if audit:
+                    print(
+                        f"Integrity audit: {audit} sampled rows/level "
+                        "re-expanded through the legacy kernels "
+                        f"(fail-stop after {audit_retries} strikes)",
+                        file=out,
+                    )
+                try:
+                    res = chk.run(
+                        max_depth=max_depth,
+                        checkpoint_dir=checkpoint_dir,
+                        checkpoint_every=checkpoint_every,
+                        resume_from=recover,
+                    )
+                finally:
+                    wd_teardown()
 
     summary = summarize(res, chk, time.monotonic() - t0)
     summary["_res"] = res
@@ -457,8 +522,27 @@ def main(argv=None) -> int:
                    help="supervisor mode: run the check as a child "
                         "process and relaunch it from its own "
                         "--checkpoint-dir up to N times after a crash "
-                        "or preemption (model verdicts and usage "
-                        "errors are terminal, never relaunched)")
+                        "or preemption (model verdicts, usage errors "
+                        "and integrity fail-stops are terminal, never "
+                        "relaunched)")
+    p.add_argument("--audit", type=int, default=0, metavar="N",
+                   help="end-to-end integrity audit: every level, "
+                        "re-expand N deterministic frontier rows "
+                        "through the retained legacy kernels and "
+                        "cross-check children/guards/fingerprints "
+                        "against the hot path; on mismatch the level "
+                        "is quarantined and the run rewinds to the "
+                        "last committed checkpoint (single-device "
+                        "engine; docs/ROBUSTNESS.md)")
+    p.add_argument("--audit-retries", type=int, default=3, metavar="R",
+                   help="fail-stop (exit 4) after R reproducible audit "
+                        "mismatches (default 3)")
+    p.add_argument("--watchdog", type=float, default=0.0, metavar="SECS",
+                   help="per-level hang watchdog: arm every level with "
+                        "a deadline of max(SECS, 8x the previous "
+                        "level's wall time); a hung device dispatch "
+                        "becomes a clean resumable exit 75 instead of "
+                        "an infinite stall (0 = off)")
     p.add_argument("--mesh", type=int, default=0,
                    help="run distributed over an N-device mesh (0 = single device)")
     p.add_argument("--exchange", choices=("all_to_all", "all_gather"),
@@ -622,12 +706,46 @@ def main(argv=None) -> int:
                 None if args.prewarm is None else bool(args.prewarm)
             ),
             use_mxu=_mxu_arg(args),
+            audit=args.audit,
+            audit_retries=args.audit_retries,
+            watchdog=args.watchdog,
             progress=progress,
             out=out,
             install_signals=(args.backend != "oracle"),
         )
     except resilience.Preempted as e:
         return _report_preempted(e, out, logf)
+    except resilience.integrity.IntegrityError as e:
+        # the whole integrity family is exit 4: an audit mismatch that
+        # reproduced across its rewind budget (AuditFailStop) AND the
+        # always-on conservation checks (exchange count reconciliation,
+        # slab occupancy, corrupt fp stream) — none of these is a model
+        # verdict, and exiting 1 would report a fake violation to the
+        # supervisor and every fleet scheduler watching the code
+        print(f"Integrity fail-stop: {e}", file=out)
+        if logf:
+            logf.close()
+        return 4
+    except Exception as e:  # graftlint: waive[GL003] — classifier
+        # catch: device-loss errors map to exit 75, everything else
+        # re-raises unchanged two lines down
+        if resilience.elastic.is_device_loss(e):
+            # a mesh participant failed: committed levels are durable,
+            # so this is RESUMABLE — exit 75 (EX_TEMPFAIL) like a
+            # preemption; --supervise relaunches and the elastic
+            # resume re-shards onto the surviving devices
+            print(f"Device loss: {type(e).__name__}: {e}.", file=out)
+            if args.checkpoint_dir:
+                print(
+                    f"Resume with --recover {args.checkpoint_dir} "
+                    "(any surviving device count: owner remap "
+                    "re-shards the log)",
+                    file=out,
+                )
+            if logf:
+                logf.close()
+            return 75
+        raise
     res = summary["_res"]
     chk = summary["_chk"]
     sanitizer = summary["_sanitizer"]

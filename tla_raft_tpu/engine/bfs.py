@@ -458,6 +458,9 @@ class JaxChecker:
         pipeline_window: int | None = None,
         prewarm: bool | None = None,
         use_mxu: bool | None = None,
+        audit: int = 0,
+        audit_retries: int = 3,
+        watchdog=None,
     ):
         # canon="late": expand computes guards only; the compacted
         # candidates are materialized and fingerprinted with the full-state
@@ -629,6 +632,24 @@ class JaxChecker:
         # check, whose specific "fingerprint-definition mismatch" error
         # tells the operator which knob to flip.
         self._run_fp = resilience.run_config_fingerprint(cfg, log="delta")
+        # sampled recomputation audit (resilience/integrity.py): every
+        # level, ``audit`` deterministic new-frontier rows re-expand
+        # through the retained *_legacy kernels and cross-check guards/
+        # fingerprints against the (MXU) hot path AND the frontier as
+        # materialized on device; a mismatch quarantines the level and
+        # rewinds to the last committed checkpoint, fail-stopping after
+        # ``audit_retries`` reproducible strikes.
+        self.audit = max(0, int(audit))
+        self.audit_retries = max(1, int(audit_retries))
+        self.audit_stats = dict(
+            levels=0, sampled=0, mismatches=0, rewinds=0
+        )
+        self._audit_strikes = 0
+        self._audit_strike_depth = None  # level the strikes belong to
+        self._audit_keys: set = set()  # declared audit program shapes
+        # per-level hang watchdog (resilience/elastic.py), armed by the
+        # level loop; None = off
+        self.watchdog = watchdog
         self._jit_expand_programs()
 
     def _jit_expand_programs(self):
@@ -1019,6 +1040,242 @@ class JaxChecker:
     #
     # * **monolith** (``latest.npz``, back-compat): full frontier +
     #   visited store in one file; O(1) resume but O(frontier) fetch.
+
+# -- end-to-end integrity audit (resilience/integrity.py) --------------
+
+    def _flip_frontier_row(self, frontier):
+        """Apply the ``tensor.flip`` fault: XOR bit 0 of the first live
+        frontier row's ``current_term[0]`` on device.  Row 0 is always
+        live (frontier rows compact to a prefix) and is always in the
+        audit sample (integrity.audit_indices) — the injected flip is
+        deterministically catchable."""
+
+        def flip_first(x):
+            return x.at[0, 0].set(x[0, 0] ^ jnp.asarray(1, x.dtype))
+
+        if isinstance(frontier, list):
+            seg = frontier[0]
+            if isinstance(seg, _HostSeg):
+                f = dict(seg.fields)
+                ct = np.array(f["current_term"], copy=True)
+                ct[0, 0] ^= 1
+                f["current_term"] = ct
+                return [_HostSeg(f)] + frontier[1:]
+            return [
+                seg._replace(current_term=flip_first(seg.current_term))
+            ] + frontier[1:]
+        return frontier._replace(
+            current_term=flip_first(frontier.current_term)
+        )
+
+    def _audit_impl_rows(self, par_rows: Frontier, kid_rows: Frontier,
+                         slots):
+        """The audit cross-check over pre-gathered rows: (1) the legacy
+        guard must admit the recorded slot, (2) legacy materialize +
+        fingerprint must equal the recorded fp, (3) the frontier row as
+        materialized on device must re-fingerprint to the recorded fp
+        (the bit-flip catch).  Returns (guard_ok, fv_legacy, fv_now)."""
+        parents = self._inflate(par_rows)
+        kids_now = self._inflate(kid_rows)
+        valid, _mult, _ab = self.kern.expand_guards_legacy(parents)
+        guard_ok = valid[jnp.arange(slots.shape[0]), slots]
+        kids_legacy = self.kern.materialize_legacy(parents, slots)
+        fv_leg, _ff_leg = self._fp_states(kids_legacy)
+        fv_now, _ff_now = self._fp_states(kids_now)
+        return guard_ok, fv_leg.astype(U64), fv_now.astype(U64)
+
+    def _audit_impl(self, parents_f, new_frontier, pidx, idx, slots):
+        par_rows = jax.tree.map(lambda x: x[pidx], parents_f)
+        kid_rows = jax.tree.map(lambda x: x[idx], new_frontier)
+        return self._audit_impl_rows(par_rows, kid_rows, slots)
+
+    @functools.cached_property
+    def _audit_prog(self):
+        return jax.jit(self._audit_impl)
+
+    def _gather_frontier_rows(self, frontier, idx_np) -> Frontier:
+        """Sampled rows of a frontier (tree or segment list) as one
+        small device-resident Frontier batch."""
+        if isinstance(frontier, list):
+            L0 = _seg_rows(frontier[0])
+            parts = []
+            for i in idx_np:
+                si, off = divmod(int(i), L0)
+                seg = frontier[si]
+                if isinstance(seg, _HostSeg):
+                    parts.append(Frontier(**{
+                        f: jnp.asarray(v[off: off + 1])
+                        for f, v in seg.fields.items()
+                    }))
+                else:
+                    parts.append(
+                        jax.tree.map(lambda x: x[off: off + 1], seg)
+                    )
+            return jax.tree.map(lambda *xs: jnp.concatenate(xs), *parts)
+        ii = jnp.asarray(np.asarray(idx_np, np.int64))
+        return jax.tree.map(lambda x: x[ii], frontier)
+
+    def _audit_level(self, parents_f, new_frontier, pidx_np, slot_np,
+                     level_fps, n_new, depth):
+        """Re-expand a deterministic sample through the legacy kernels
+        and cross-check against the hot path; returns the list of
+        problem strings (empty = level verified)."""
+        idx = resilience.integrity.audit_indices(n_new, self.audit)
+        if idx.size == 0:
+            return []
+        self.audit_stats["levels"] += 1
+        self.audit_stats["sampled"] += int(idx.size)
+        # pad the sample to the fixed --audit width (repeating row 0) so
+        # the audit programs compile once per frontier shape, not once
+        # per distinct sample size; comparisons only read the live lanes
+        n_live = int(idx.size)
+        if n_live < self.audit:
+            idx = np.concatenate([
+                idx, np.full(self.audit - n_live, idx[0], np.int64)
+            ])
+        # recorded level fingerprints at the sampled rows (host numpy on
+        # the external-store path; a tiny device gather otherwise)
+        if isinstance(level_fps, np.ndarray):
+            ref = level_fps[idx].astype(np.uint64)
+        else:
+            # graftlint: waive[GL006] — audit-mode-only sampled fetch
+            ref = np.asarray(jax.device_get(
+                level_fps[jnp.asarray(idx)]
+            )).astype(np.uint64)
+        pidx_s = jnp.asarray(np.asarray(pidx_np)[idx], I64)
+        slots = jnp.asarray(np.asarray(slot_np)[idx], I64)
+        idx_d = jnp.asarray(idx, I64)
+        if not isinstance(parents_f, list) and not isinstance(
+            new_frontier, list
+        ):
+            # device-resident frontiers: the whole cross-check — row
+            # gathers, inflate, legacy guards/materialize, fingerprints
+            # — runs as ONE jitted program per (parent cap, child cap)
+            # shape pair, so audit overhead is two small dispatches +
+            # one fetch per level, not ~30 eager ops
+            key = (
+                parents_f.voted_for.shape[0],
+                new_frontier.voted_for.shape[0], self.audit,
+            )
+            if key not in self._audit_keys:
+                self._audit_keys.add(key)
+                graft_sanitize.note_shape_event(f"audit program {key}")
+            guard_ok, fv_leg, fv_now = self._audit_prog(
+                parents_f, new_frontier, pidx_s, idx_d, slots
+            )
+        else:
+            par_rows = self._gather_frontier_rows(
+                parents_f, np.asarray(pidx_np)[idx]
+            )
+            kid_rows = self._gather_frontier_rows(new_frontier, idx)
+            guard_ok, fv_leg, fv_now = self._audit_impl_rows(
+                par_rows, kid_rows, slots
+            )
+        # graftlint: waive[GL006] — audit-mode-only verdict fetch
+        guard_np, leg_np, now_np = jax.device_get((
+            guard_ok, fv_leg.astype(U64), fv_now.astype(U64)
+        ))
+        guard_np = np.asarray(guard_np, bool)
+        leg_np = np.asarray(leg_np, np.uint64)
+        now_np = np.asarray(now_np, np.uint64)
+        problems = []
+        for j, row in enumerate(idx[:n_live]):
+            if not guard_np[j]:
+                problems.append(
+                    f"row {int(row)}: legacy guard refutes recorded "
+                    f"slot {int(np.asarray(slot_np)[row])}"
+                )
+            if leg_np[j] != ref[j]:
+                problems.append(
+                    f"row {int(row)}: legacy re-expansion fp "
+                    f"{leg_np[j]:#x} != recorded {ref[j]:#x}"
+                )
+            if now_np[j] != ref[j]:
+                problems.append(
+                    f"row {int(row)}: materialized frontier row "
+                    f"re-fingerprints to {now_np[j]:#x} != recorded "
+                    f"{ref[j]:#x} (corrupted frontier tensor)"
+                )
+        if problems:
+            self.audit_stats["mismatches"] += len(problems)
+            for p in problems[:8]:
+                print(f"[integrity] audit level {depth + 1}: {p}",
+                      file=sys.stderr)
+        return problems
+
+    def _audit_rewind(self, problems, depth, max_depth, checkpoint_dir,
+                      checkpoint_every):
+        """Quarantine the mismatched level and rewind to the last
+        committed checkpoint; fail-stop after ``audit_retries``
+        reproducible strikes.
+
+        The mismatched level never reached the delta log (the audit
+        runs before the commit), so the rewind is a plain self-resume:
+        the replay re-materializes every level from the durable
+        (parent, slot) decisions — tensors are recomputed, so the
+        corruption cannot survive the rewind unless it is
+        deterministic, which is exactly what the strike budget
+        detects.  "Reproducible" means AT THE SAME LEVEL: strikes
+        count per mismatch depth and reset when a different level
+        mismatches, so independent transient flips hours apart never
+        sum into a fake fail-stop; a hard cap on TOTAL rewinds
+        (4x the budget) still bounds a corruption source that hops
+        between levels."""
+        if self._audit_strike_depth == depth:
+            self._audit_strikes += 1
+        else:
+            self._audit_strikes = 1
+            self._audit_strike_depth = depth
+        strikes = self._audit_strikes
+        if self.audit_stats["rewinds"] >= 4 * self.audit_retries:
+            raise resilience.integrity.AuditFailStop(
+                f"audit mismatches forced {self.audit_stats['rewinds']} "
+                f"rewinds in one run (cap {4 * self.audit_retries}): "
+                "pervasive corruption — fail-stop; latest problem: "
+                + problems[0]
+            )
+        if strikes >= self.audit_retries:
+            raise resilience.integrity.AuditFailStop(
+                f"audit mismatch at level {depth + 1} reproduced "
+                f"{strikes} time(s) (budget {self.audit_retries}): "
+                "deterministic corruption — fail-stop; first problem: "
+                + problems[0]
+            )
+        import glob as _glob
+
+        can_resume = bool(checkpoint_dir and checkpoint_every)
+        has_records = can_resume and bool(
+            _glob.glob(os.path.join(checkpoint_dir, "delta_*.npz"))
+            or os.path.exists(os.path.join(checkpoint_dir, "base.npz"))
+        )
+        if not can_resume:
+            raise resilience.integrity.AuditFailStop(
+                f"audit mismatch at level {depth + 1} with no "
+                "checkpoint directory to rewind to — fail-stop; first "
+                "problem: " + problems[0]
+            )
+        self.audit_stats["rewinds"] += 1
+        print(
+            f"[integrity] quarantining level {depth + 1} and rewinding "
+            f"to the last committed checkpoint (strike {strikes}/"
+            f"{self.audit_retries})",
+            file=sys.stderr,
+        )
+        # the in-memory run state (visited slab/store, frontier) is
+        # polluted by the quarantined level — drop it all and rebuild
+        # from the durable log
+        self.hstore = None
+        self._hs_pending = None
+        if self.host_store is not None and not has_records:
+            # a fresh restart re-inserts from Init; pre-crash inserts
+            # would silently mark reachable states visited
+            self.host_store.clear()
+        return self._run(
+            max_depth=max_depth,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            resume_from=checkpoint_dir if has_records else None,
+        )
 
     def _save_delta(self, ckdir, depth, pidx_np, slot_np, fps_np,
                     level_mult, n_new):
@@ -2442,12 +2699,15 @@ class JaxChecker:
         checkpoint_every: int = 1,
         resume_from: str | None = None,
     ) -> CheckResult:
+        self._audit_strikes = 0
         try:
             return self._run(
                 max_depth=max_depth, checkpoint_dir=checkpoint_dir,
                 checkpoint_every=checkpoint_every, resume_from=resume_from,
             )
         finally:
+            if self.watchdog is not None:
+                self.watchdog.disarm()
             if self._prewarmer is not None:
                 # run over (done, raised, or preempted): give the almost-
                 # finished tail a bounded grace to land in the persistent
@@ -2647,6 +2907,13 @@ class JaxChecker:
                 )
             if max_depth is not None and depth >= max_depth:
                 break
+            if self.watchdog is not None:
+                # armed BEFORE the device fault sites: an injected hang
+                # at the dispatch site is exactly what it must convert
+                # into a clean exit 75
+                self.watchdog.arm(f"level {depth + 1} (single-device)")
+            resilience.fault_fire("device.lost")
+            resilience.fault_fire("device.hang")
             if self.presize and len(level_sizes) > PRESIZE_MIN_LEVELS:
                 self._update_presize(level_sizes, distinct, max_depth,
                                      frontier)
@@ -2795,7 +3062,17 @@ class JaxChecker:
                 if b >= 0:
                     bad_idx = si * sl + int(b)
                     break
+            # the audit re-expands sampled rows from their PARENTS, so
+            # the pre-swap frontier must outlive the swap (audit runs
+            # only; production keeps the old drop-at-swap lifetime)
+            parent_prev = frontier if self.audit else None
             frontier = new_frontier
+            if resilience.fault_flag("tensor.flip"):
+                # injected silent corruption: one bit of the first live
+                # frontier row flips ON DEVICE after materialize — the
+                # recorded fingerprints disagree with the slab from here
+                # on, which is exactly what --audit must catch
+                frontier = self._flip_frontier_row(frontier)
 
             # --- bookkeeping, store merge -------------------------------
             distinct += n_new
@@ -2902,6 +3179,19 @@ class JaxChecker:
                         self._trace(trace_levels, depth, bad_idx),
                     ),
                 )
+            # --- sampled recomputation audit (BEFORE the level's delta
+            # record commits: a caught level never enters the log) -----
+            if self.audit and n_new:
+                problems = self._audit_level(
+                    parent_prev, frontier, pidx_np, slot_np,
+                    fps_host if fps_host is not None else new_fps,
+                    n_new, depth,
+                )
+                if problems:
+                    return self._audit_rewind(
+                        problems, depth, max_depth, checkpoint_dir,
+                        checkpoint_every,
+                    )
             # checkpoint only invariant-clean levels: a resumed run never
             # re-checks its loaded frontier, so saving before the check
             # could hide a violation behind a crash+resume.  Delta-log
@@ -2939,6 +3229,13 @@ class JaxChecker:
                 )
                 if (self.use_hashstore and dump_every
                         and depth % dump_every == 0):
+                    # slab-occupancy conservation check at the dump
+                    # cadence: the snapshot about to be trusted by a
+                    # future resume must count exactly the distinct set
+                    resilience.integrity.occupancy_check(
+                        "device hash slab", self.hstore.occupancy(),
+                        distinct, level=depth,
+                    )
                     self.hstore.dump(
                         os.path.join(checkpoint_dir, "hslab.npz"),
                         depth, int(self.orbit), run_fp=self._run_fp,
@@ -2947,6 +3244,8 @@ class JaxChecker:
                     # the level's per-group partials are superseded by its
                     # delta record (only the in-flight level ever has any)
                     self._wipe_partials(checkpoint_dir)
+            if self.watchdog is not None:
+                self.watchdog.disarm()
 
         return CheckResult(
             True, distinct, generated, depth, tuple(level_sizes), None,

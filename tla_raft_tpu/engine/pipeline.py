@@ -158,6 +158,10 @@ class AsyncFetchWindow:
         AsyncFetchWindow.live -= 1
         host = fetch(arrays)
         graft_sanitize.note_async_fetch_complete()
+        # progress heartbeat: a completed fetch group proves the level
+        # is still moving, so the hang watchdog re-earns its budget —
+        # long multi-window levels never false-trip on total wall time
+        resilience.elastic.watchdog_touch()
         if run_consume:
             consume(host)
 

@@ -413,6 +413,11 @@ def insert_np(slab: np.ndarray, fps: np.ndarray) -> np.ndarray:
     return slab
 
 
+@jax.jit
+def _live_count(slab):
+    return (slab != jnp.uint64(SENT)).sum()
+
+
 class DeviceHashStore:
     """Host-side wrapper: one device slab + growth/rehash + checkpoints.
 
@@ -448,6 +453,13 @@ class DeviceHashStore:
 
     def need_grow(self, extra: int = 0) -> bool:
         return (self.count + extra) * 2 > self.cap
+
+    def occupancy(self) -> int:
+        """Live (non-SENT) slots, counted ON DEVICE — the integrity
+        audit's slab-occupancy-vs-distinct conservation check.  One
+        O(cap) reduce; callers run it at the slab-dump cadence, not
+        per level."""
+        return int(jax.device_get(_live_count(self.slab)))
 
     def adopt(self, slab, n_new: int):
         """Accept a level's updated slab (after the redo loop exits)."""

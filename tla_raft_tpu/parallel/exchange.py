@@ -82,8 +82,18 @@ def pack_fp_deltas(fps_sorted: jnp.ndarray, n: jnp.ndarray):
 
 
 def unpack_fp_deltas(stream: np.ndarray, nibbles: np.ndarray,
-                     count: int) -> np.ndarray:
-    """Host-side inverse of :func:`pack_fp_deltas` -> u64[count]."""
+                     count: int, verify: bool = False) -> np.ndarray:
+    """Host-side inverse of :func:`pack_fp_deltas` -> u64[count].
+
+    ``verify=True`` adds the exchange-stream integrity check the deep
+    level tail runs before inserting into the owner stores: the packed
+    form encodes a STRICTLY ASCENDING unique sequence, so the decoded
+    output must be strictly increasing — a flipped bit in the stream,
+    the nibble header or the prefix fetch almost surely produces a
+    duplicate (zero delta), a wrapped cumsum or a garbage length, all
+    of which break monotonicity.  One O(count) compare buys end-to-end
+    detection on the host leg that the per-record digests cannot give
+    (the fetch crosses the link AFTER any checksumming)."""
     if count == 0:
         return np.empty(0, np.uint64)
     nib = np.asarray(nibbles[: (count + 1) // 2], np.uint8)
@@ -99,7 +109,19 @@ def unpack_fp_deltas(stream: np.ndarray, nibbles: np.ndarray,
         if not m.any():
             break
         delta[m] |= st[off[m] + b].astype(np.uint64) << np.uint64(8 * b)
-    return np.cumsum(delta, dtype=np.uint64)
+    out = np.cumsum(delta, dtype=np.uint64)
+    if verify and count > 1 and not (out[1:] > out[:-1]).all():
+        from ..resilience.integrity import IntegrityError
+
+        bad = int(np.argmin(out[1:] > out[:-1]))
+        raise IntegrityError(
+            f"corrupt fingerprint exchange stream: decoded entry "
+            f"{bad + 1} of {count} is not strictly greater than its "
+            "predecessor (the packed form encodes a sorted unique "
+            "sequence) — a bit flipped between the owner's finalize "
+            "and the host fetch"
+        )
+    return out
 
 
 def packed_quantum(nbytes: int) -> int:

@@ -266,6 +266,7 @@ class ShardedChecker:
         pipeline: bool | None = None,
         pipeline_window: int | None = None,
         use_mxu: bool | None = None,
+        watchdog=None,
     ):
         assert exchange in ("all_to_all", "all_gather")
         # async intra-level pipeline (engine/pipeline.py): the level's
@@ -367,11 +368,34 @@ class ShardedChecker:
         self.progress = progress
         self.inv_fns = [(n, resolve_invariant_kernel(n)) for n in cfg.invariants]
         # semantic run fingerprint for the checkpoint manifests: spec
-        # constants + everything the mdelta record meta already pins
-        # (D, exchange, canon) — NOT tunables (cap_x, seg_rows), which
-        # a resume may retune freely
+        # constants + the modes the mdelta record meta pins (exchange,
+        # canon) — NOT tunables (cap_x, seg_rows) and, since the
+        # elastic-resume work, NOT the device count: a D-device log
+        # resumes on D' devices by owner remap (resilience/elastic.py),
+        # so D is per-record geometry now, never log identity
         self._run_fp = resilience.run_config_fingerprint(
-            cfg, log="mdelta", D=self.D, exchange=exchange, canon=canon
+            cfg, log="mdelta", exchange=exchange, canon=canon
+        )
+        # per-owner level skew metrics (resilience/integrity.py): new
+        # rows per owner every level, plus per-owner store-insert
+        # seconds on the deep path — the --json "straggler" block
+        self.skew = resilience.integrity.SkewMeter(self.D)
+        # per-level hang watchdog (resilience/elastic.py); None = off
+        self.watchdog = watchdog
+
+    def _legacy_run_fps(self) -> tuple[str, ...]:
+        """Pre-elastic run fingerprints of THIS semantic run: the old
+        digest schema pinned the writing mesh's device count, which an
+        elastic resume cannot know up front — accept the variant for
+        every plausible width so an upgraded deployment's in-progress
+        checkpoints stay resumable (heal_log migrates the manifest to
+        the D-free form on first touch)."""
+        return tuple(
+            resilience.run_config_fingerprint(
+                self.cfg, log="mdelta", D=d, exchange=self.exchange,
+                canon=self.canon,
+            )
+            for d in range(1, 129)
         )
 
     # -- the per-device level body ----------------------------------------
@@ -932,11 +956,9 @@ class ShardedChecker:
             for k in ("level_phase2", "cap_w"):
                 self.__dict__.pop(k, None)
         n2 = int(jax.device_get(p2.n_new_total))
-        if n2 != n_new:
-            raise RuntimeError(
-                f"host-store verdict mismatch: stores admitted {n_new} "
-                f"new states, phase 2 materialized {n2}"
-            )
+        resilience.integrity.reconcile(
+            "host-store verdict map", n_new, n2
+        )
         return SimpleNamespace(
             children=p2.children, child_msum=p2.child_msum,
             n_new_local=p2.n_new_local, n_new_total=p2.n_new_total,
@@ -1541,10 +1563,48 @@ class ShardedChecker:
         except (OSError, ValueError, KeyError):
             return
         if (
-            ver != hashstore.SLAB_VERSION or d != depth or Dz != self.D
+            ver != hashstore.SLAB_VERSION or d != depth
             or hs != int(self.use_hashstore)
             or slab.shape[0] != Dz * rows
         ):
+            return
+        if Dz != self.D:
+            # elastic resume: the snapshot was cut for a Dz-device mesh.
+            # The sieve is origin-keyed (device d holds what d routed),
+            # so no fp-based slice reproduces the old locality — instead
+            # REPLICATE the union of all shards into every new shard
+            # when it fits (every entry is provably in the store, so any
+            # superset-per-shard is still an exact sieve), else start
+            # empty and re-learn.
+            live = slab[slab != SENT]
+            union = np.unique(live)
+            if len(union) == 0:
+                return
+            if self.use_hashstore:
+                rows_new = hashstore.slab_rows(len(union))
+            else:
+                rows_new = 1 << (max(1, 2 * len(union)) - 1).bit_length()
+            if rows_new > self.scap_max:
+                return
+            if self.use_hashstore:
+                new = hashstore.rebuild_np(
+                    [union] * self.D, rows_new
+                )
+            else:
+                new = np.full((self.D, rows_new), SENT)
+                new[:, : len(union)] = np.sort(union)[None, :]
+            print(
+                f"[elastic] sieve slab repartitioned {Dz} -> {self.D} "
+                f"shards ({len(union)} entries replicated, "
+                f"{rows_new} rows/shard)",
+                file=sys.stderr,
+            )
+            self.scap = rows_new
+            # graftlint: waive[GL006] — one-time elastic-resume upload
+            self._sieve_cache = jax.device_put(
+                jnp.asarray(new).reshape(-1), shard
+            )
+            self._dp.clear()
             return
         self.scap = rows
         self._sieve_cache = jax.device_put(jnp.asarray(slab), shard)
@@ -1694,19 +1754,29 @@ class ShardedChecker:
             fetch_prefixes, "deep exchange prefix fetch"
         )
         inserted = np.zeros(D, np.int64)
+        insert_secs = np.zeros(D, np.float64)
 
         def insert_one(o):
+            t_o = time.monotonic()
             n_o = int(n_us[o])
             if n_o == 0:
                 return
             if packed_ok:
-                fps = unpack_fp_deltas(st_all[o], nb_all[o], n_o)
+                # verify=True: the decoded stream must be strictly
+                # ascending (integrity check on the host leg)
+                fps = unpack_fp_deltas(
+                    st_all[o], nb_all[o], n_o, verify=True
+                )
             else:
                 fps = uq_all[o][:n_o]
             is_new = self.host_stores[o].insert(fps)
             inserted[o] = int(is_new.sum())
             pb = np.packbits(is_new, bitorder="little")
             bits_np[o, : len(pb)] = pb[:vq]
+            # per-owner insert wall time: the straggler-skew signal of
+            # the double-buffered level tail (one slow store shard =
+            # one degraded host/disk path)
+            insert_secs[o] = time.monotonic() - t_o
 
         list(self._io_pool.map(insert_one, range(D)))
         meter.note_packed(packed_ok)
@@ -1744,11 +1814,12 @@ class ShardedChecker:
         )
         n2 = sum(int(x) for x in n2s)
         n_new = int(inserted.sum())
-        if n2 != n_new:
-            raise RuntimeError(
-                f"deep verdict mismatch: stores admitted {n_new} new "
-                f"states, phase 2 materialized {n2}"
-            )
+        # per-owner count reconciliation across the exchange: what the
+        # owner stores admitted must equal what the origins materialized
+        resilience.integrity.reconcile(
+            "deep owner exchange", n_new, n2, level=depth + 1
+        )
+        self.skew.note(depth + 1, rows=inserted, seconds=insert_secs)
         inv_total = sum(int(x) for x in invs)
         inv = None
         if inv_total > 0:
@@ -1974,6 +2045,7 @@ class ShardedChecker:
             if ck_fut is not None:
                 ck_fut.result()
                 ck_fut = None
+                self._ck_fut = None
 
         while True:
             resilience.fault_fire("level.start")
@@ -1987,6 +2059,10 @@ class ShardedChecker:
                 )
             if max_depth is not None and depth >= max_depth:
                 break
+            if self.watchdog is not None:
+                self.watchdog.arm(f"mesh-deep level {depth + 1}")
+            resilience.fault_fire("device.lost")
+            resilience.fault_fire("device.hang")
             if presize and len(level_sizes) > MIN_LEVELS:
                 fc = per_device_forecast(
                     level_sizes, distinct, max_depth, D
@@ -2048,6 +2124,15 @@ class ShardedChecker:
                 self.peak_dev_rows, len(segments) * seg
             )
             distinct += n_new
+            # store-occupancy conservation: the per-owner external
+            # stores must jointly hold exactly the distinct set (a
+            # lost/duplicated insert would silently skew every later
+            # sieve drop and verdict)
+            resilience.integrity.occupancy_check(
+                "deep per-owner stores",
+                sum(len(s) for s in self.host_stores), distinct,
+                level=depth + 1,
+            )
             level_sizes.append(n_new)
             depth += 1
             trace_levels.append((out["gpidx"], out["slots"]))
@@ -2115,10 +2200,12 @@ class ShardedChecker:
                     sieve_np = np.asarray(
                         jax.device_get(self._sieve_cache)
                     )
-                ck_fut = self._ck_pool.submit(
+                ck_fut = self._ck_fut = self._ck_pool.submit(
                     self._save_mdelta, checkpoint_dir, depth, ns,
                     capf_prev, sieve_np,
                 )
+            if self.watchdog is not None:
+                self.watchdog.disarm()
         join_ck()
         return CheckResult(
             True, distinct, generated, depth, tuple(level_sizes), None,
@@ -2285,6 +2372,7 @@ class ShardedChecker:
         files = resilience.heal_log(
             ckdir, "mdelta", run_fp=self._run_fp,
             slabs=("sieve_slab.npz",),
+            legacy_run_fps=self._legacy_run_fps(),
         )
         if not files:
             if resilience.Manifest.load(ckdir).exists:
@@ -2293,7 +2381,19 @@ class ShardedChecker:
                 return None
             raise ValueError(f"no mdelta_*.npz checkpoints under {ckdir}")
         cfg, K, D = self.cfg, self.K, self.D
-        frontier = init_batch(cfg, D)  # layout [D, cap_f=1]
+        # -- elastic replay: every record carries its OWN geometry -----
+        # A record's pidx index its PARENT level's layout (Dz device
+        # blocks of cap_f rows: gpidx = dev*cap_f + row) and its own
+        # rows land in a (len(n_local), cap_c) layout.  The replay
+        # tracks that per-record geometry instead of assuming the
+        # current mesh width, which is what lets a D-device log resume
+        # on D' != D devices: after the replay, ONE owner remap
+        # (resilience/elastic.py) re-shards the final frontier by
+        # fp % D' and the stores/slabs rebuild into the new partition
+        # from the replayed fingerprints.
+        z0 = np.load(files[0])
+        par_D = int(z0["meta"][2])  # the log's initial mesh width
+        frontier = init_batch(cfg, par_D)  # layout [par_D, cap_f=1]
         fv0, _ff0, _ms0 = self.fpr.state_fingerprints(
             jax.tree.map(lambda x: x[:1], frontier)
         )
@@ -2301,20 +2401,23 @@ class ShardedChecker:
         trace_levels, level_sizes = [], [1]
         mult_slots_total = np.zeros(K, np.int64)
         depth = 0
-        n_local = np.array([1] + [0] * (D - 1), np.int64)
+        n_local = np.array([1] + [0] * (par_D - 1), np.int64)
         for f in files:
             z = np.load(f)
             meta = [int(x) for x in z["meta"]]
             d, n_new, Dz, cap_f, cap_c, a2a, late = meta
+            nl = z["n_local"].astype(np.int64)
+            D_own = len(nl)  # the record's own device-block count
             if d != depth + 1:
                 raise ValueError(
                     f"mdelta log gap: expected level {depth + 1}, found "
                     f"level {d} ({f})"
                 )
-            if Dz != D:
+            if Dz != par_D:
                 raise ValueError(
-                    f"checkpoint was taken on a {Dz}-device mesh, this "
-                    f"run has {D}"
+                    f"mdelta geometry break at level {d}: record "
+                    f"expects a {Dz}-device parent layout, replay "
+                    f"built {par_D} ({f})"
                 )
             if a2a != (1 if self.exchange == "all_to_all" else 0):
                 raise ValueError(
@@ -2325,7 +2428,7 @@ class ShardedChecker:
                     "checkpoint canonicalization mode differs from this "
                     "run (pass the matching --canon)"
                 )
-            built = int(frontier.voted_for.shape[0]) // D
+            built = int(frontier.voted_for.shape[0]) // par_D
             if cap_f < built:
                 raise ValueError(
                     f"mdelta level {d} expects a {cap_f}-wide frontier, "
@@ -2336,22 +2439,21 @@ class ShardedChecker:
                 # blocks (cap_f = n_segments * seg_rows); pad each
                 # DEVICE BLOCK so the record's global parent indices
                 # (dev*cap_f + row) land on the replayed rows
-                def _padblk(x, _c=cap_f, _b=built):
-                    blk = x.reshape((self.D, _b) + x.shape[1:])
+                def _padblk(x, _c=cap_f, _b=built, _d=par_D):
+                    blk = x.reshape((_d, _b) + x.shape[1:])
                     pad = jnp.zeros(
-                        (self.D, _c - _b) + x.shape[1:], x.dtype
+                        (_d, _c - _b) + x.shape[1:], x.dtype
                     )
                     return jnp.concatenate([blk, pad], axis=1).reshape(
-                        (self.D * _c,) + x.shape[1:]
+                        (_d * _c,) + x.shape[1:]
                     )
 
                 frontier = jax.tree.map(_padblk, frontier)
-            nl = z["n_local"].astype(np.int64)
             # rebuild the padded device layout from the compact prefixes
-            gpidx = np.full(D * cap_c, -1, np.int64)
-            slots = np.zeros(D * cap_c, np.int64)
+            gpidx = np.full(D_own * cap_c, -1, np.int64)
+            slots = np.zeros(D_own * cap_c, np.int64)
             off = 0
-            for dev in range(D):
+            for dev in range(D_own):
                 c = int(nl[dev])
                 gpidx[dev * cap_c : dev * cap_c + c] = z["pidx"][off : off + c]
                 slots[dev * cap_c : dev * cap_c + c] = z["slot"][off : off + c]
@@ -2376,7 +2478,16 @@ class ShardedChecker:
             mult_slots_total = mult_slots_total + z["mult"].astype(np.int64)
             frontier = children
             n_local = nl
+            par_D = D_own  # this record's layout is the next's parent
             depth = d
+        if par_D != D and trace_levels:
+            print(
+                f"[elastic] resuming a {par_D}-device log on a "
+                f"{D}-device mesh: owner remap re-shards the frontier "
+                f"by fp % {D} and the visited structures rehash into "
+                "the new partition",
+                file=sys.stderr,
+            )
         distinct = int(sum(level_sizes))
         fps = np.unique(np.concatenate(fps_all))
         if len(fps) != distinct:
@@ -2384,45 +2495,39 @@ class ShardedChecker:
                 f"mdelta replay rebuilt {len(fps)} distinct fingerprints "
                 f"for {distinct} recorded states — corrupt or mixed log"
             )
-        # Rebalance the resumed frontier by OWNER (fp % D).  Chains
-        # written before the owner-shipping exchange (rounds 2-4) carry
-        # the whole frontier on device 0 (n_local = [N, 0, ...]); left
-        # as-is, the first resumed level would need a ~D-times-larger
-        # cap_x for one level before the new exchange heals the layout.
-        # The relabel permutes rows host-side and permutes the LAST
-        # trace record identically, so slot-chain replay stays exact
+        # Rebalance the resumed frontier by OWNER (fp % D) onto the
+        # CURRENT mesh.  Three layouts need this: chains written before
+        # the owner-shipping exchange (rounds 2-4: the whole frontier on
+        # device 0), any same-D resume whose layout drifted, and — the
+        # elastic case — a log written on a different device count,
+        # whose rows must redistribute by fp % D' before the first
+        # resumed level.  The remap permutes rows host-side
+        # (resilience/elastic.owner_rebalance), growing the per-device
+        # block when the new partition needs it, and permutes the LAST
+        # trace record identically so slot-chain replay stays exact
         # (earlier records reference their own levels' layouts, which
         # are untouched).
-        if trace_levels and D > 1:
-            cap_cr = frontier.voted_for.shape[0] // D
+        if trace_levels and (D > 1 or par_D != D):
+            cap_cr = frontier.voted_for.shape[0] // par_D
             fvh = np.asarray(jax.device_get(fv.astype(U64)))
             validh = np.asarray(valid)
-            own = np.where(
-                validh, (fvh % np.uint64(D)).astype(np.int64), D
+            perm, counts_o, cap_new = resilience.elastic.owner_rebalance(
+                fvh, validh, D,
+                min_cap=cap_cr if par_D == D else 1,
             )
-            order = np.argsort(own, kind="stable")
-            counts_o = np.bincount(own, minlength=D + 1)[:D]
-            if counts_o.max() > cap_cr:
-                raise ValueError(
-                    f"owner rebalance needs {counts_o.max()} rows/device "
-                    f"but the replayed frontier block is {cap_cr}"
-                )
-            starts_o = np.cumsum(counts_o) - counts_o
-            perm = np.full(D * cap_cr, -1, np.int64)
-            for o in range(D):
-                seg = order[starts_o[o] : starts_o[o] + counts_o[o]]
-                perm[o * cap_cr : o * cap_cr + counts_o[o]] = seg
             lane = perm >= 0
             safe = np.clip(perm, 0, None)
-            frontier = jax.tree.map(
-                lambda x: jnp.where(
-                    jnp.asarray(lane).reshape(
-                        (-1,) + (1,) * (x.ndim - 1)
-                    ),
-                    x[jnp.asarray(safe)], jnp.zeros_like(x),
-                ),
-                frontier,
-            )
+            lane_dev = jnp.asarray(lane)
+            safe_dev = jnp.asarray(safe)
+
+            def _remap(x):
+                g = x[safe_dev]
+                return jnp.where(
+                    lane_dev.reshape((-1,) + (1,) * (x.ndim - 1)),
+                    g, jnp.zeros_like(g),
+                )
+
+            frontier = jax.tree.map(_remap, frontier)
             gpidx_l, slots_l = trace_levels[-1]
             gpidx_n = np.where(lane, gpidx_l[safe], -1)
             slots_n = np.where(lane, slots_l[safe], 0)
@@ -2432,9 +2537,13 @@ class ShardedChecker:
             # resume reference the REBALANCED level-d row positions, so
             # the on-disk level-d record must describe them or the next
             # full replay gathers wrong parents and dies as "corrupt or
-            # mixed log".  Row order + n_local change; the record's pidx
-            # values (indices into level d-1) are untouched.
+            # mixed log".  Row order, n_local and (elastic case) the
+            # own-layout geometry (cap_c + device-block count) change;
+            # the record's pidx values AND its parent geometry (Dz,
+            # cap_f — what the indices point into) are untouched.
             z_last = np.load(files[-1])
+            meta_n = [int(x) for x in z_last["meta"]]
+            meta_n[4] = int(cap_new)
             validn = gpidx_n >= 0
             slot_dt = z_last["slot"].dtype
             pidx_dt = (
@@ -2451,7 +2560,7 @@ class ShardedChecker:
                     slot=slots_n[validn].astype(slot_dt),
                     n_local=n_local,
                     mult=z_last["mult"],
-                    meta=z_last["meta"],
+                    meta=np.asarray(meta_n, np.int64),
                 ),
                 kind="mdelta",
                 depth=depth,
@@ -2578,6 +2687,50 @@ class ShardedChecker:
         resume_from: str | None = None,
         presize: bool = True,
     ) -> CheckResult:
+        try:
+            return self._run_impl(
+                max_depth=max_depth, checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                resume_from=resume_from, presize=presize,
+            )
+        except BaseException as e:  # graftlint: waive[GL003] —
+            # crash-path bookkeeping only: the tail write joins, device
+            # loss gets a note, and the exception ALWAYS re-raises
+            # a crash (device loss included) must not lose the deep
+            # path's deferred tail write: join it so everything the
+            # level loop committed stays on disk, then let the CLI map
+            # device loss to exit 75 — --supervise relaunches and the
+            # elastic resume re-shards onto the surviving mesh
+            fut, self._ck_fut = getattr(self, "_ck_fut", None), None
+            if fut is not None:
+                try:
+                    fut.result()
+                except Exception:  # graftlint: waive[GL003] — the
+                    # original crash must propagate, not the tail
+                    # writer's secondary failure
+                    pass
+            if resilience.elastic.is_device_loss(e):
+                print(
+                    "[elastic] device failure mid-run — committed "
+                    "levels are durable"
+                    + (f" in {checkpoint_dir}" if checkpoint_dir else "")
+                    + "; a relaunch resumes over the surviving mesh",
+                    file=sys.stderr,
+                )
+            raise
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.disarm()
+
+    def _run_impl(
+        self,
+        max_depth: int | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
+        resume_from: str | None = None,
+        presize: bool = True,
+    ) -> CheckResult:
+        self._ck_fut = None
         if self.deep:
             return self.run_deep(
                 max_depth=max_depth, checkpoint_dir=checkpoint_dir,
@@ -2809,6 +2962,10 @@ class ShardedChecker:
                 )
             if max_depth is not None and depth >= max_depth:
                 break
+            if self.watchdog is not None:
+                self.watchdog.arm(f"mesh level {depth + 1}")
+            resilience.fault_fire("device.lost")
+            resilience.fault_fire("device.hang")
             if presize and len(level_sizes) > MIN_LEVELS:
                 visited = maybe_presize(visited)
             if self.host_stores is not None:
@@ -2874,8 +3031,19 @@ class ShardedChecker:
             mult_slots_total += np.asarray(mult_np)
             generated += int(gen_np)
             n_new = int(nnew_np)
+            # per-owner count reconciliation across the exchange: the
+            # psum'd owner-store admissions must equal the winners the
+            # origins shipped and materialized
+            resilience.integrity.reconcile(
+                "mesh owner exchange", n_new,
+                int(np.asarray(nloc_np, np.int64).sum()),
+                level=depth + 1,
+            )
             if n_new == 0:
                 break
+            self.skew.note(
+                depth + 1, rows=np.asarray(nloc_np, np.int64).reshape(-1)
+            )
             cap_f_prev = frontier.voted_for.shape[0] // D
             distinct += n_new
             level_sizes.append(n_new)
@@ -2971,6 +3139,10 @@ class ShardedChecker:
                     ),
                     cap_f_prev,
                 )
+            if self.watchdog is not None:
+                # per-level disarm records this level's wall time so
+                # the next arm's budget adapts (max(floor, 8x last))
+                self.watchdog.disarm()
 
         return CheckResult(
             True, distinct, generated, depth, tuple(level_sizes), None,

@@ -11,10 +11,22 @@ Layers (docs/ROBUSTNESS.md):
 * ``faults``    — the deterministic ``FaultPlan``
   (``TLA_RAFT_FAULT`` / ``--fault``) that makes all of the above
   testable on CPU in tier-1.
+* ``elastic``   — device-loss re-sharding (owner remap onto D' != D
+  devices), device-loss classification, and the per-level hang
+  watchdog.
+* ``integrity`` — always-on conservation checks and the opt-in
+  ``--audit`` sampled-recomputation cross-check with rewind/fail-stop.
 """
 
-from .faults import FAULT_SITES, FaultError, FaultPlan  # noqa: F401
+from . import elastic, integrity  # noqa: F401
+from .faults import (  # noqa: F401
+    FAULT_SITES,
+    DeviceLost,
+    FaultError,
+    FaultPlan,
+)
 from .faults import fire as fault_fire  # noqa: F401
+from .faults import fire_flag as fault_flag  # noqa: F401
 from .faults import install as fault_install  # noqa: F401
 from .manifest import (  # noqa: F401
     Manifest,

@@ -1,0 +1,307 @@
+"""Elastic mesh recovery: device-loss re-sharding + hang watchdogs.
+
+Production accelerator fleets treat device loss and stragglers as
+routine events to be absorbed, not outages: a sweep that dies because
+one mesh participant failed — or that can only resume on exactly the
+device count it started with — is not production-scale anything.  This
+module holds the device-failure half of the resilience subsystem:
+
+* **Owner remap** (:func:`owner_rebalance`) — the host-side math that
+  re-shards a replayed frontier onto a *different* device count.  The
+  owner-sharded layout (``fp % D``) already contains everything needed:
+  ownership is a pure function of the fingerprint, so a D-device log
+  replays into record-layout coordinates and one stable owner sort
+  redistributes the live rows across D′ devices.  The mesh resume
+  (``parallel/sharded.py``) uses this for the frontier and rebuilds the
+  hash slabs / external store shards into the new partition from the
+  replayed fingerprints (a rehash, not a copy: slot homes move with
+  ``fp % D``).
+
+* **Device-loss classification** (:func:`is_device_loss`) — one place
+  that decides whether an exception means "a device/XLA participant
+  failed" (resumable over the surviving mesh: exit 75, ``--supervise``
+  relaunches, elastic resume absorbs the smaller mesh) versus an
+  ordinary bug that must propagate.
+
+* **Watchdog** (:class:`Watchdog`) — a per-level deadline thread that
+  converts a hung XLA dispatch into a clean resumable exit instead of
+  an infinite stall.  Armed at each level start with a budget of
+  ``max(floor, mult * last_level_seconds)`` (generous multipliers: a
+  level is only a straggler when it blows far past its predecessor);
+  async fetch completions ``touch()`` the deadline so a slow-but-
+  progressing level never false-trips.  On expiry it first requests
+  cooperative preemption (a merely-slow level then flushes and raises
+  ``Preempted`` at the next poll), and only if the run stays wedged
+  past the grace window hard-exits 75 — the durable per-level log makes
+  that resumable by construction.
+
+Module contract: device-free import (numpy only, no jax) — the import
+hygiene gate (tests/test_import_clean.py) covers the whole package.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+from . import faults, recover
+
+
+def _pow2ceil(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+# -- owner remap: re-shard a replayed frontier onto D' devices ------------
+
+def owner_rebalance(fp_view: np.ndarray, valid: np.ndarray, D: int,
+                    min_cap: int = 1):
+    """Permutation that re-shards live rows by owner (``fp % D``).
+
+    ``fp_view``/``valid`` describe a flat replayed frontier in ANY
+    source layout (the live rows are wherever the log's layout put
+    them).  Returns ``(perm, counts, cap)``: ``cap`` is the pow2
+    per-device block width (>= ``min_cap``, sized to the heaviest
+    owner), ``perm`` is an i64[D*cap] gather map (target row -> source
+    row, -1 for padding) placing owner ``o``'s rows — in stable source
+    order — at the prefix of block ``o``, and ``counts`` the per-owner
+    live totals.  Works for D == 1 (a plain compaction) and for any
+    source-layout device count: ownership is a function of the
+    fingerprint alone, which is exactly what makes the mesh elastic.
+    """
+    fp_view = np.asarray(fp_view, np.uint64)
+    valid = np.asarray(valid, bool)
+    own = np.where(valid, (fp_view % np.uint64(D)).astype(np.int64), D)
+    counts = np.bincount(own, minlength=D + 1)[:D].astype(np.int64)
+    # keep the caller's block width when it already fits (a same-D
+    # resume then reuses its layout verbatim); grow pow2 otherwise
+    need = max(int(counts.max()) if D else 1, 1)
+    cap = int(min_cap) if need <= int(min_cap) else _pow2ceil(need)
+    order = np.argsort(own, kind="stable")
+    starts = np.cumsum(counts) - counts
+    perm = np.full(D * cap, -1, np.int64)
+    for o in range(D):
+        seg = order[starts[o]: starts[o] + counts[o]]
+        perm[o * cap: o * cap + counts[o]] = seg
+    return perm, counts, cap
+
+
+# -- device-loss classification -------------------------------------------
+
+# substrings that mark a BACKEND runtime error as "a device went away"
+# rather than a program bug.  Deliberately conservative: a misclassified
+# bug would relaunch-loop instead of surfacing, so only the XLA/PJRT
+# runtime exception types are consulted (never a bare RuntimeError) and
+# only health-shaped messages count — "deadline exceeded"/"unavailable"
+# are the canonical surviving-peer symptoms of a dead collective
+# participant under the pinned XLA collective-timeout flags (xla_env).
+_DEVICE_LOSS_MARKERS = (
+    "device lost",
+    "device is lost",
+    "deadline exceeded",
+    "failed to enqueue",
+    "socket closed",
+    "connection reset",
+    "unavailable:",
+    "halted execution",
+    "device failure",
+)
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """True when ``exc`` means a mesh participant/device failed.
+
+    Covers the injected :class:`faults.DeviceLost` and the backend's
+    ``XlaRuntimeError`` family when the message carries a device-health
+    marker.  Everything else — including plain ``RuntimeError``s whose
+    text happens to mention a marker — is an ordinary error and must
+    propagate with its traceback.
+    """
+    if isinstance(exc, faults.DeviceLost):
+        return True
+    name = type(exc).__name__
+    if name not in ("XlaRuntimeError", "JaxRuntimeError"):
+        return False
+    msg = str(exc).lower()
+    return any(m in msg for m in _DEVICE_LOSS_MARKERS)
+
+
+def effective_mesh(requested: int, out=None) -> int:
+    """Clamp a resumed run's mesh width to the surviving device count.
+
+    A relaunch after device loss sees fewer devices than the original
+    ``--mesh N``; refusing to start would defeat the elastic resume, so
+    recovery runs re-shard onto what is actually there.  Fresh runs
+    keep the strict ``make_mesh`` error (a typo'd --mesh must fail)."""
+    import jax  # deferred: callers are already past backend init
+
+    avail = len(jax.devices())
+    if requested <= avail:
+        return requested
+    msg = (
+        f"[elastic] requested a {requested}-device mesh but only "
+        f"{avail} device(s) survive — re-sharding the resumed run "
+        f"onto {avail} (owner remap, fp % {avail})"
+    )
+    print(msg, file=out if out is not None else sys.stderr)
+    return avail
+
+
+# -- the level watchdog ----------------------------------------------------
+
+_WATCHDOG: "Watchdog | None" = None
+
+
+def install_watchdog(wd: "Watchdog | None") -> None:
+    """Publish the run's watchdog so deep layers (the async pipeline's
+    fetch completions) can ``touch()`` it without plumbing."""
+    global _WATCHDOG
+    _WATCHDOG = wd
+
+
+def watchdog_touch() -> None:
+    wd = _WATCHDOG
+    if wd is not None:
+        wd.touch()
+
+
+class Watchdog:
+    """Per-level deadline thread: hung dispatch -> clean exit 75.
+
+    ``floor`` is the minimum per-level budget in seconds (the CLI's
+    ``--watchdog SECS``); the armed budget is
+    ``max(floor, mult * last_level_seconds)`` so organic level growth
+    never trips it while a wedged collective (one lost participant, a
+    deadlocked rendezvous) does.  Expiry ladder: request cooperative
+    preemption first (a slow level finishes, flushes checkpoints and
+    raises ``Preempted`` — exit 75 with a durable log), then after the
+    grace window hard-exit 75 (``os._exit`` — a truly hung dispatch
+    never returns to Python, so nothing gentler can run).
+    """
+
+    def __init__(self, floor: float, mult: float = 8.0,
+                 on_hard_timeout=None):
+        self.floor = float(floor)
+        self.mult = float(mult)
+        self.fired = 0
+        self._hist: list[float] = []  # recent level wall times
+        self._cv = threading.Condition()
+        self._armed: dict | None = None
+        self._fired_ctx: dict | None = None  # consumed level, mid-grace
+        self._stop = False
+        self._last_release = 0.0
+        self._thread: threading.Thread | None = None
+        self._hard = on_hard_timeout or self._default_hard_timeout
+
+    @staticmethod
+    def _default_hard_timeout():
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os._exit(75)
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="tla-raft-watchdog", daemon=True
+            )
+            self._thread.start()
+
+    def arm(self, context: str) -> None:
+        last = self._hist[-1] if self._hist else 0.0
+        budget = max(self.floor, self.mult * last)
+        if not self._hist:
+            # the first armed level of a (re)launched process pays the
+            # cold compile ladder with no history and (pre-pipeline)
+            # no touch() heartbeats; at the bare floor a supervised
+            # relaunch could hard-kill it mid-compile every time and
+            # make zero progress — give the cold level the same
+            # multiplier headroom an adaptive level would get
+            budget = max(budget, self.mult * self.floor)
+        with self._cv:
+            self._armed = dict(
+                context=context, budget=budget,
+                started=time.monotonic(),
+                deadline=time.monotonic() + budget,
+            )
+            self._cv.notify_all()
+        self._ensure_thread()
+
+    def touch(self) -> None:
+        """Progress heartbeat (async fetch completions, store inserts):
+        a level that keeps moving keeps earning its budget."""
+        with self._cv:
+            a = self._armed
+            if a is not None:
+                a["deadline"] = time.monotonic() + a["budget"]
+
+    def disarm(self) -> None:
+        with self._cv:
+            # _fire consumes _armed before sleeping out the grace; a
+            # level that then finishes must still record its wall time
+            # (via the parked fired context) or the next arm's adaptive
+            # budget would be computed from a level two-plus back and
+            # false-trip the following one
+            a = self._armed or self._fired_ctx
+            self._armed = None
+            self._fired_ctx = None
+            self._last_release = time.monotonic()
+            if a is not None:
+                self._hist.append(time.monotonic() - a["started"])
+                del self._hist[:-3]
+
+    def cancel(self) -> None:
+        with self._cv:
+            self._armed = None
+            self._stop = True
+            self._last_release = time.monotonic()
+            self._cv.notify_all()
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._stop and self._armed is None:
+                    self._cv.wait()
+                if self._stop:
+                    return
+                a = self._armed
+                now = time.monotonic()
+                if now < a["deadline"]:
+                    self._cv.wait(a["deadline"] - now)
+                    continue
+                self._armed = None
+                ctx = dict(a)
+                self._fired_ctx = ctx
+            self._fire(ctx)
+
+    def _fire(self, a: dict):
+        self.fired += 1
+        fire_t = time.monotonic()
+        print(
+            f"[watchdog] {a['context']} exceeded its "
+            f"{a['budget']:.1f}s deadline — requesting cooperative "
+            "preemption (flush-and-exit-resumable)",
+            file=sys.stderr,
+        )
+        sys.stderr.flush()
+        recover.request_preempt()
+        # the grace scales with the armed budget (a level trusted with
+        # a 2-minute budget earns a proportionate wind-down) so a slow-
+        # but-finishing level exits COOPERATIVELY with its record
+        # committed instead of being hard-killed into a no-progress
+        # relaunch loop; capped so a real hang still dies promptly
+        grace = min(max(self.floor, 1.0, 0.5 * a["budget"]), 60.0)
+        time.sleep(grace)
+        with self._cv:
+            released = self._last_release >= fire_t or self._stop
+        if released:
+            return  # the run reacted (finished the level or exited)
+        print(
+            f"[watchdog] {a['context']} still wedged "
+            f"{grace:.1f}s after preemption request — hard exit 75 "
+            "(state through the last committed level is durable)",
+            file=sys.stderr,
+        )
+        self._hard()

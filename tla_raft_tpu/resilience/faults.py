@@ -79,13 +79,35 @@ FAULT_SITES = {
     "lease.commit": "service worker lease: renamed (unmanifested kind)",
     "bstate.tmp": "bucket snapshot: tmp written, not renamed",
     "bstate.commit": "bucket snapshot: renamed, not manifested",
+    # elastic-mesh / silent-corruption sites (resilience/elastic.py,
+    # resilience/integrity.py): device failures and bit flips are
+    # runtime events, not writer events, so their actions are applied
+    # by the instrumented code path itself (``lost``/``hang`` raise or
+    # block at the site; ``tensor.flip`` is polled with ``fire_flag``
+    # and the engine flips the first live frontier row on device)
+    "device.lost": "top of a level's device dispatch: a device/XLA "
+                   "failure (action `lost` raises DeviceLost; the CLI "
+                   "maps it to exit 75 so --supervise relaunches over "
+                   "the surviving mesh)",
+    "device.hang": "top of a level's device dispatch: a hung XLA "
+                   "dispatch (action `hang` blocks forever; the level "
+                   "watchdog converts it to a clean exit 75)",
+    "tensor.flip": "single-device level end: one bit of the first live "
+                   "frontier row flips on device (action `flip`; the "
+                   "--audit cross-check catches it and rewinds)",
 }
 
-_ACTIONS = ("kill", "torn", "flip", "fail")
+_ACTIONS = ("kill", "torn", "flip", "fail", "lost", "hang")
 
 
 class FaultError(RuntimeError):
     """An injected transient failure (``fail`` action)."""
+
+
+class DeviceLost(RuntimeError):
+    """An injected device/XLA failure (``lost`` action): the mesh lost
+    a participant mid-run.  ``elastic.is_device_loss`` classifies this
+    together with the real backend's runtime errors."""
 
 
 class FaultPlan:
@@ -133,6 +155,26 @@ class FaultPlan:
             self.fired.append(f"{site}:{action}@{n}")
             self._perform(site, action, n, path)
 
+    def fire_flag(self, site: str) -> bool:
+        """Hit a site whose ``flip`` action is applied BY THE CALLER
+        (in-memory tensor flips have no artifact path to mutate here):
+        returns True when an armed ``flip`` trigger fires at this hit.
+        Other actions armed on the site still perform normally."""
+        n = self.counts.get(site, 0) + 1
+        self.counts[site] = n
+        hit = False
+        for tsite, action, tn in self.triggers:
+            if tsite != site or tn != n:
+                continue
+            self.fired.append(f"{site}:{action}@{n}")
+            if action == "flip":
+                print(f"[fault] {site}:flip@{n} — caller applies the "
+                      "in-memory flip", file=sys.stderr)
+                hit = True
+            else:
+                self._perform(site, action, n, None)
+        return hit
+
     def _perform(self, site, action, n, path):
         note = f"[fault] {site}:{action}@{n}"
         if action == "kill":
@@ -141,6 +183,22 @@ class FaultPlan:
             os.kill(os.getpid(), signal.SIGKILL)
         if action == "fail":
             raise FaultError(f"injected transient failure at {site} (#{n})")
+        if action == "lost":
+            print(f"{note} — raising DeviceLost", file=sys.stderr)
+            raise DeviceLost(
+                f"injected device loss at {site} (#{n}): a mesh "
+                "participant failed mid-run"
+            )
+        if action == "hang":
+            # the closest userspace approximation of a hung XLA
+            # dispatch: the instrumented (main) thread blocks forever;
+            # only the watchdog's hard exit or an external kill ends it
+            print(f"{note} — hanging this thread forever", file=sys.stderr)
+            sys.stderr.flush()
+            import time
+
+            while True:
+                time.sleep(60)
         if path is None or not os.path.exists(path):
             raise ValueError(
                 f"fault {site}:{action} needs an artifact path but the "
@@ -192,3 +250,11 @@ def fire(site: str, path: str | None = None) -> None:
     p = plan()
     if p.triggers:
         p.fire(site, path)
+
+
+def fire_flag(site: str) -> bool:
+    """Hit a caller-applied site; True = perform the flip now."""
+    p = plan()
+    if p.triggers:
+        return p.fire_flag(site)
+    return False

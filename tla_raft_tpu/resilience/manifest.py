@@ -153,11 +153,19 @@ class Manifest:
 
     # -- mutation ------------------------------------------------------
 
-    def bind_run(self, run_fp: str | None):
-        """Pin (or check) the directory's run config fingerprint."""
+    def bind_run(self, run_fp: str | None,
+                 accept: tuple[str, ...] = ()):
+        """Pin (or check) the directory's run config fingerprint.
+
+        ``accept`` lists LEGACY fingerprint variants of the same
+        semantic run (fields since removed from the digest — e.g. the
+        mesh device count, dropped when resume went elastic): a
+        manifest bound to one of them MIGRATES to ``run_fp`` in place
+        instead of refusing a valid log.  The rebinding persists on the
+        next commit (every heal/append commits)."""
         if run_fp is None:
             return
-        if self.run_fp is None:
+        if self.run_fp is None or self.run_fp in accept:
             self.run_fp = run_fp
         elif self.run_fp != run_fp:
             raise RunMismatch(

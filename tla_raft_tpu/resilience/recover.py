@@ -86,6 +86,7 @@ def heal_log(
     run_fp: str | None = None,
     slabs: tuple[str, ...] = (),
     start_depth: int = 1,
+    legacy_run_fps: tuple[str, ...] = (),
 ) -> list[str]:
     """Verify + heal a checkpoint directory; return the usable records.
 
@@ -93,15 +94,28 @@ def heal_log(
     optional side snapshots to verify alongside (bad ones are
     quarantined — their loaders already fall back to rebuild-from-log).
     ``start_depth`` is where the chain is expected to begin (after a
-    ``base.npz`` monolith it is base depth + 1).  Returns the sorted
-    paths of the surviving contiguous records.  Raises ``ValueError``
-    on an interior hole and ``RunMismatch`` when the manifest belongs
-    to a different run configuration.
+    ``base.npz`` monolith it is base depth + 1).  ``legacy_run_fps``
+    names fingerprint variants of the SAME semantic run from older
+    digest schemas (the mesh resume passes its D-pinned pre-elastic
+    forms): a manifest bound to one migrates to ``run_fp`` and the
+    migration commits with the heal, so later appends bind cleanly.
+    Returns the sorted paths of the surviving contiguous records.
+    Raises ``ValueError`` on an interior hole and ``RunMismatch`` when
+    the manifest belongs to a genuinely different run configuration.
     """
     sweep_tmp(ckdir)
     m = Manifest.load(ckdir)
-    m.bind_run(run_fp)
-    dirty = False
+    migrated = (
+        m.exists and run_fp is not None and m.run_fp is not None
+        and m.run_fp != run_fp and m.run_fp in legacy_run_fps
+    )
+    m.bind_run(run_fp, accept=legacy_run_fps)
+    if migrated:
+        _note(
+            f"migrated {ckdir} manifest run fingerprint from a legacy "
+            "digest schema (pre-elastic D-pinned form)"
+        )
+    dirty = migrated
 
     files = sorted(glob.glob(os.path.join(ckdir, f"{prefix}_*.npz")))
     good: dict[int, str] = {}
@@ -213,12 +227,20 @@ def discard_artifacts(ckdir: str, names) -> None:
 # -- bounded retry for transient failures ---------------------------------
 
 def with_retry(fn, what: str, attempts: int = 4, base_delay: float = 0.05,
-               retry_on: tuple = (faults.FaultError, OSError)):
-    """Call ``fn()`` with exponential backoff on transient errors.
+               retry_on: tuple = (faults.FaultError, OSError),
+               jitter: bool = True):
+    """Call ``fn()`` with exponential backoff + jitter on transient errors.
 
     Only for IDEMPOTENT operations (re-fetching a device array,
-    re-reading a file); the last failure propagates.
+    re-reading a file, rewriting a lease); the last failure propagates.
+    ``jitter`` draws each delay uniformly from [0.5, 1.5) of the
+    exponential step: when many workers hit one shared filesystem (the
+    sweep service's lease renewals), synchronized retries re-collide at
+    exactly the backoff boundaries — decorrelating them is what lets a
+    transient FS brownout clear instead of resonating.
     """
+    import random
+
     for i in range(attempts):
         try:
             return fn()
@@ -226,6 +248,8 @@ def with_retry(fn, what: str, attempts: int = 4, base_delay: float = 0.05,
             if i == attempts - 1:
                 raise
             delay = base_delay * (2 ** i)
+            if jitter:
+                delay *= 0.5 + random.random()
             _note(
                 f"transient failure in {what} (attempt {i + 1}/"
                 f"{attempts}): {e} — retrying in {delay:.2f}s"

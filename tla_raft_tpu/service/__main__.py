@@ -188,7 +188,10 @@ def _cmd_run(args, raw_argv) -> int:
     # re-pays the whole bucket compile ladder every restart
     setup_jax()
     resilience.install_signal_handlers()
-    q = JobQueue(args.root, lease_ttl=args.lease_ttl)
+    q = JobQueue(
+        args.root, lease_ttl=args.lease_ttl,
+        max_attempts=args.retry_budget,
+    )
     sched = Scheduler(
         q, batch=not args.no_batch, min_bucket=args.min_bucket,
     )
@@ -257,6 +260,11 @@ def main(argv=None) -> int:
     pd.add_argument("--lease-ttl", type=float, default=30.0,
                     help="seconds without a heartbeat before a "
                          "worker's claim is presumed dead")
+    pd.add_argument("--retry-budget", type=int, default=3, metavar="N",
+                    help="poison-job quarantine: a job whose worker "
+                         "dies N times is failed with its accumulated "
+                         "failure log and moved to failed/ instead of "
+                         "being requeued forever (default 3)")
     pd.add_argument("--supervise", type=int, default=0, metavar="N",
                     help="relaunch a crashed/preempted scheduler up "
                          "to N times")

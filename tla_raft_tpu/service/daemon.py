@@ -107,7 +107,7 @@ class Scheduler:
         self.stats = dict(
             jobs_done=0, jobs_failed=0, buckets=0, batched_jobs=0,
             sequential_jobs=0, max_bucket=0, dispatches=0, programs=0,
-            recovered=0, config_dispatch_weight=0,
+            recovered=0, config_dispatch_weight=0, poisoned=0,
         )
 
     def _say(self, msg: str) -> None:
@@ -278,6 +278,17 @@ class Scheduler:
             self.stats["recovered"] += len(recovered)
             self._say(f"requeued {len(recovered)} stale job(s): "
                       f"{recovered}")
+        poisoned = getattr(self.q, "poisoned_last", [])
+        if poisoned:
+            # poison-job quarantine: these workers' deaths exhausted the
+            # retry budget — failed with the accumulated failure log and
+            # moved to failed/, so the queue drains instead of looping
+            self.stats["poisoned"] += len(poisoned)
+            self.stats["jobs_failed"] += len(poisoned)
+            self._say(
+                f"poisoned {len(poisoned)} job(s) (worker died >= "
+                f"{self.q.max_attempts}x; moved to failed/): {poisoned}"
+            )
         pending = self.q.pending(states)
         buckets, singles = self.plan(pending)
         for key, jobs in buckets:

@@ -23,7 +23,18 @@ State machine::
 
     submitted --claim--> running --complete--> done | failed
         ^                   |
-        +---requeue (stale lease / preemption / crash)---+
+        +---requeue (stale lease / preemption / crash,
+        |            attempt < max_attempts)-------------+
+        +---poison  (stale lease, attempt >= max_attempts):
+                     failed, job dir moved to root/failed/
+
+**Poison-job quarantine**: a job whose worker dies ``max_attempts``
+times (default 3) is not requeued forever — the stale-lease sweep
+fails it with the accumulated per-attempt failure log (worker, note,
+timestamp, carried in ``state.json`` across requeues), commits a
+``result.json`` recording the poisoning, and moves the whole job
+directory to ``root/failed/``, out of the scheduler's pending scan.
+Status/result reads follow it there.
 
 Every JSON record commits through ``resilience.commit_json`` (the
 atomic tmp -> digest -> rename -> MANIFEST.json writer, graftlint
@@ -81,20 +92,34 @@ def doc_to_cfg(doc: dict) -> RaftConfig:
     return RaftConfig(**kw)
 
 
+FAILED_DIR = "failed"
+
+
 class JobQueue:
     """The queue API both the client CLI and the daemon go through."""
 
     def __init__(self, root: str, worker: str | None = None,
-                 lease_ttl: float = 30.0):
+                 lease_ttl: float = 30.0, max_attempts: int = 3):
         self.root = root
         self.jobs_dir = os.path.join(root, "jobs")
+        self.failed_dir = os.path.join(root, FAILED_DIR)
         self.worker = worker or f"w{os.getpid()}"
         self.lease_ttl = float(lease_ttl)
+        # poison-job retry budget: a job whose worker dies this many
+        # times moves to failed/ instead of requeueing forever
+        self.max_attempts = max(1, int(max_attempts))
 
     # -- paths ---------------------------------------------------------
 
     def job_dir(self, job_id: str) -> str:
-        return os.path.join(self.jobs_dir, job_id)
+        jd = os.path.join(self.jobs_dir, job_id)
+        if not os.path.isdir(jd):
+            # poisoned jobs move wholesale to failed/; status and
+            # result reads follow them there
+            fd = os.path.join(self.failed_dir, job_id)
+            if os.path.isdir(fd):
+                return fd
+        return jd
 
     def ck_dir(self, job_id: str) -> str:
         return os.path.join(self.job_dir(job_id), CKDIR)
@@ -146,13 +171,16 @@ class JobQueue:
         return resilience.load_json_verified(self.job_dir(job_id), RESULT)
 
     def list_jobs(self) -> list[str]:
-        try:
-            return sorted(
-                d for d in os.listdir(self.jobs_dir)
-                if os.path.isdir(os.path.join(self.jobs_dir, d))
-            )
-        except FileNotFoundError:
-            return []
+        out = set()
+        for base in (self.jobs_dir, self.failed_dir):
+            try:
+                out.update(
+                    d for d in os.listdir(base)
+                    if os.path.isdir(os.path.join(base, d))
+                )
+            except FileNotFoundError:
+                pass
+        return sorted(out)
 
     def job_cfg(self, job_id: str) -> RaftConfig | None:
         spec = self.load_spec(job_id)
@@ -161,12 +189,18 @@ class JobQueue:
     # -- state machine -------------------------------------------------
 
     def _set_state(self, job_id: str, status: str, *, attempt: int,
-                   worker: str | None = None, note: str | None = None):
+                   worker: str | None = None, note: str | None = None,
+                   failures: list | None = None):
         assert status in STATUSES, status
+        doc = dict(schema=QUEUE_SCHEMA, status=status, attempt=int(attempt),
+                   worker=worker, note=note)
+        if failures:
+            # the accumulated per-attempt failure log (requeue reasons);
+            # rides every later transition so the poison record carries
+            # the job's whole failure history
+            doc["failures"] = list(failures)
         resilience.commit_json(
-            self.job_dir(job_id), STATE,
-            dict(schema=QUEUE_SCHEMA, status=status, attempt=int(attempt),
-                 worker=worker, note=note),
+            self.job_dir(job_id), STATE, doc,
             kind="jobstate",
         )
 
@@ -224,16 +258,28 @@ class JobQueue:
             fh.write("\n")
         self._set_state(
             job_id, "running", attempt=int(st.get("attempt", 0)) + 1,
-            worker=self.worker,
+            worker=self.worker, failures=st.get("failures"),
         )
         return True
 
     def heartbeat(self, job_id: str, beats: int = 0) -> None:
-        """Refresh the lease mtime (atomic rewrite, unmanifested)."""
-        resilience.commit_json(
-            self.job_dir(job_id), LEASE,
-            dict(worker=self.worker, pid=os.getpid(), beats=int(beats)),
-            kind="lease", manifest=False,
+        """Refresh the lease mtime (atomic rewrite, unmanifested).
+
+        Retried with exponential backoff + jitter: a transient FS
+        error (NFS brownout, ENOSPC blip) on one heartbeat must not
+        age a HEALTHY worker's lease past the TTL and hand its job to
+        a second scheduler.  The write is idempotent (same lease doc),
+        so the retry is safe; jitter decorrelates a fleet of workers
+        all beating against the same brownout."""
+        resilience.with_retry(
+            lambda: resilience.commit_json(
+                self.job_dir(job_id), LEASE,
+                dict(worker=self.worker, pid=os.getpid(),
+                     beats=int(beats)),
+                kind="lease", manifest=False,
+            ),
+            f"lease renewal ({job_id})",
+            attempts=3, base_delay=0.05, jitter=True,
         )
 
     def _lease_dead(self, job_id: str) -> bool:
@@ -270,7 +316,7 @@ class JobQueue:
         self._set_state(
             job_id, "done" if summary.get("ok") else "failed",
             attempt=int(st.get("attempt", 0)), worker=self.worker,
-            note=summary.get("violation"),
+            note=summary.get("violation"), failures=st.get("failures"),
         )
         try:
             os.unlink(self._lease_path(job_id))
@@ -282,7 +328,7 @@ class JobQueue:
         st = self.load_state(job_id)
         self._set_state(
             job_id, "submitted", attempt=int(st.get("attempt", 0)),
-            note=note,
+            note=note, failures=st.get("failures"),
         )
         try:
             os.unlink(self._lease_path(job_id))
@@ -311,26 +357,85 @@ class JobQueue:
         """Requeue every running job whose lease is stale or missing —
         the crash-recovery sweep each scheduler pass runs first.  The
         job's checkpoint dir is left intact: the retry RESUMES.
-        Mutates ``states`` (when given) to reflect the requeues."""
+
+        A job whose worker has now died ``max_attempts`` times is
+        POISONED instead (``_poison``): failed with the accumulated
+        failure log and moved to ``root/failed/`` — a config that
+        reliably kills its worker (OOM, a crashing kernel) must not
+        starve the queue by being requeued forever.  Poisoned ids land
+        in ``self.poisoned_last`` for the scheduler's stats.
+
+        Mutates ``states`` (when given) to reflect the transitions."""
         out = []
+        self.poisoned_last: list[str] = []
         states = self.scan() if states is None else states
         for jid, st in states.items():
             if st["status"] != "running":
                 continue
             age = self.lease_age(jid)
             if age is None or age > self.lease_ttl or self._lease_dead(jid):
-                self._set_state(
-                    jid, "submitted", attempt=int(st.get("attempt", 0)),
-                    note=f"requeued (stale lease, worker "
-                         f"{st.get('worker')})",
-                )
+                attempt = int(st.get("attempt", 0))
+                failures = list(st.get("failures") or [])
+                failures.append(dict(
+                    attempt=attempt,
+                    worker=st.get("worker"),
+                    note="worker died (stale/dead lease)",
+                    time=time.time(),
+                ))
                 try:
                     os.unlink(self._lease_path(jid))
                 except OSError:
                     pass
+                if attempt >= self.max_attempts:
+                    self._poison(jid, attempt, failures)
+                    states[jid] = dict(st, status="failed")
+                    self.poisoned_last.append(jid)
+                    continue
+                self._set_state(
+                    jid, "submitted", attempt=attempt,
+                    note=f"requeued (stale lease, worker "
+                         f"{st.get('worker')})",
+                    failures=failures,
+                )
                 states[jid] = dict(st, status="submitted")
                 out.append(jid)
         return out
+
+    def _poison(self, job_id: str, attempt: int, failures: list) -> None:
+        """Quarantine a job that kills its workers: fail it with the
+        accumulated failure log, commit a result record, and move the
+        whole job directory to ``root/failed/`` (same-filesystem
+        rename — atomic), out of the pending scan."""
+        note = (
+            f"poisoned: worker died {attempt} time(s) "
+            f"(retry budget {self.max_attempts})"
+        )
+        self._set_state(
+            job_id, "failed", attempt=attempt, note=note,
+            failures=failures,
+        )
+        resilience.commit_json(
+            self.job_dir(job_id), RESULT,
+            dict(
+                schema=QUEUE_SCHEMA, ok=False, distinct=0, generated=0,
+                depth=0, level_sizes=[], mxu=None, seconds=None,
+                violation=note, failures=failures,
+            ),
+            kind="result",
+        )
+        src = os.path.join(self.jobs_dir, job_id)
+        dst = os.path.join(self.failed_dir, job_id)
+        if os.path.isdir(src):
+            os.makedirs(self.failed_dir, exist_ok=True)
+            try:
+                # whole-directory quarantine move (jobs/ -> failed/),
+                # not a checkpoint commit; the records inside were all
+                # committed atomically already
+                # graftlint: waive[GL009]
+                os.replace(src, dst)
+            except OSError:
+                pass  # cross-device or racing sweep: failed-in-place
+                # still drains (status is terminal either way)
 
     def pending(self, states: dict | None = None) -> list[str]:
         """Jobs ready to claim (after the stale-lease sweep)."""
