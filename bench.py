@@ -37,7 +37,9 @@ BENCH_VALS / BENCH_MAX_ELECTION (scale dials, BASELINE.md configs 3-5),
 BENCH_GOLD_DEPTH (oracle prefix depth), RAFT_CFG, BENCH_HASHSTORE (0 =
 sort-path A/B), BENCH_PIPELINE (0 = serial-chain A/B) /
 BENCH_PIPELINE_WINDOW (in-flight fetch groups, default 2), BENCH_MXU
-(0 = legacy per-lane expand A/B), BENCH_AUDIT (1 = integrity audit at
+(0 = legacy per-lane expand A/B), BENCH_MEGAKERNEL (0 = staged
+program-chain A/B vs the fused whole-level program; dispatches/level
+land in the record either way), BENCH_AUDIT (1 = integrity audit at
 BENCH_AUDIT_N rows/level, default 64 — overhead A/B, single-device
 arm), BENCH_SERVICE (1 = the sweep-service
 jobs/hour A/B on the synthetic queue instead — see _bench_service).
@@ -561,6 +563,11 @@ def main():
         # expand"); counts are bit-identical either way, so the parity
         # gates hold in both arms
         use_mxu = bool(int(os.environ.get("BENCH_MXU", "1")))
+        # BENCH_MEGAKERNEL=0 pins the staged per-stage program chain —
+        # the A/B lever for the whole-level megakernel (docs/PERF.md
+        # "Whole-level megakernel"); counts are bit-identical either
+        # way, so the parity gates hold in both arms
+        use_mega = bool(int(os.environ.get("BENCH_MEGAKERNEL", "1")))
         # BENCH_AUDIT=1 arms the end-to-end integrity audit at
         # BENCH_AUDIT_N rows/level (default 64) — the A/B lever for the
         # audit-mode overhead record (docs/ROBUSTNESS.md; target < 5%
@@ -603,12 +610,24 @@ def main():
             peak_dev_rows = getattr(mchk, "peak_dev_rows", None)
             pipe_on, pipe_win = mchk.pipeline, mchk.pipeline_window
         else:
-            chk1 = JaxChecker(
-                cfg, chunk=chunk, progress=progress, use_hashstore=use_hs,
-                pipeline=use_pipe, pipeline_window=pipe_window,
-                use_mxu=use_mxu, audit=audit_n,
-            )
-            res = chk1.run(max_depth=max_depth)
+            # per-level program-dispatch ledger (analysis.sanitize
+            # choke-point accounting): the megakernel A/B record reports
+            # dispatches/level in both arms
+            from tla_raft_tpu.analysis import sanitize as _san
+
+            dlog = _san.DispatchLog()
+            _san.set_dispatch_sink(dlog)
+            try:
+                chk1 = JaxChecker(
+                    cfg, chunk=chunk, progress=progress,
+                    use_hashstore=use_hs,
+                    pipeline=use_pipe, pipeline_window=pipe_window,
+                    use_mxu=use_mxu, megakernel=use_mega, audit=audit_n,
+                )
+                res = chk1.run(max_depth=max_depth)
+            finally:
+                _san.set_dispatch_sink(None)
+            dlog.close()
             pipe_on, pipe_win = chk1.pipeline, chk1.pipeline_window
     except Exception as e:
         _emit_failure("engine_run", e)
@@ -712,8 +731,24 @@ def main():
         "pipeline": pipe_on,
         "pipeline_window": pipe_win if pipe_on else 0,
         "mxu": use_mxu,
+        # the EFFECTIVE state, not the lever: a sort-path arm
+        # (BENCH_HASHSTORE=0) runs staged regardless of the env flag
+        "megakernel": (
+            bool(getattr(chk1, "megakernel", False)) if not mesh_n
+            else False
+        ),
         "audit": audit_n if not mesh_n else 0,
     }
+    if not mesh_n:
+        # per-level wall clock + program dispatches (the fused-vs-
+        # staged A/B's secondary metric: launches/level is exactly
+        # what the megakernel removes)
+        out["level_seconds"] = [
+            round(levels[i][2] - (levels[i - 1][2] if i else 0.0), 4)
+            for i in range(len(levels))
+        ]
+        out["dispatches_per_level"] = list(dlog.per_level)
+        out["steady_max_dispatches_per_level"] = dlog.steady_max()
     if full_golden is not None:
         out["golden_full"] = {
             "distinct": full_golden[0],
@@ -761,9 +796,12 @@ def main():
             "pipeline": out["pipeline"],
             "pipeline_window": out["pipeline_window"],
             "mxu": out["mxu"],
+            "megakernel": out["megakernel"],
             "audit": out["audit"],
         }
-        for k in ("mesh", "mesh_deep", "peak_dev_rows", "exchange"):
+        for k in ("mesh", "mesh_deep", "peak_dev_rows", "exchange",
+                  "level_seconds", "dispatches_per_level",
+                  "steady_max_dispatches_per_level"):
             if k in out:
                 record[k] = out[k]
         tmp = bench_out + ".tmp"

@@ -57,6 +57,13 @@ for s in "${steps[@]}"; do
            # delta is the guard-matmul + gather-free-materialize win on
            # real silicon (the gather cliff does not exist on CPU)
       run_bench docs/BENCH_S3_LEGACY_r11.json BENCH_MXU=0 ;;
+    s3staged) # staged program-chain A/B arm for the whole-level
+           # megakernel (docs/PERF.md "Whole-level megakernel"):
+           # identical s3 run with BENCH_MEGAKERNEL=0 — counts must be
+           # bit-identical; the wall-clock delta on silicon is the
+           # dispatch-floor win (2-4 fewer programs + 1 fewer ledgered
+           # fetch per steady-state level at ~38 ms/launch)
+      run_bench docs/BENCH_S3_STAGED_r14.json BENCH_MEGAKERNEL=0 ;;
     s5)    # scale config 3 (warm steady-state — run s5 twice; the
            # second run reads the persistent compile cache).  Gold depth 9
            # as in r3: the Python oracle's S! fold makes depth 12 a ~45-min

@@ -14,6 +14,8 @@ import sys
 MODULES = [
     "tla_raft_tpu",
     "tla_raft_tpu.engine.bfs",
+    "tla_raft_tpu.engine.megakernel",
+    "tla_raft_tpu.analysis.dispatch_audit",
     "tla_raft_tpu.parallel.sharded",
     "tla_raft_tpu.parallel.exchange",
     "tla_raft_tpu.engine.forecast",

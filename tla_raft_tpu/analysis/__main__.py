@@ -18,7 +18,7 @@ import json
 import os
 import sys
 
-from . import ast_lint, jaxpr_audit
+from . import ast_lint, dispatch_audit, jaxpr_audit
 
 
 def main(argv=None) -> int:
@@ -29,6 +29,10 @@ def main(argv=None) -> int:
                    metavar="RULE", help="run only these rules (repeatable)")
     p.add_argument("--no-jaxpr", action="store_true",
                    help="skip the jaxpr audit (layer 2 needs jax)")
+    p.add_argument("--no-dispatch", action="store_true",
+                   help="skip the GL011 per-level dispatch-budget audit "
+                        "(runs the tiny config through both level-loop "
+                        "paths; needs jax)")
     p.add_argument("--no-baseline", action="store_true",
                    help="report baselined findings too")
     p.add_argument("--baseline", default=ast_lint.BASELINE_PATH,
@@ -71,6 +75,15 @@ def main(argv=None) -> int:
         jaxpr_audit.write_golden(ledger, args.ledger)
         n = len(ledger) - 1
         print(f"wrote {n} kernel ledgers to {args.ledger}")
+        dledger = dispatch_audit.build_ledger()
+        dispatch_audit.write_golden(dledger)
+        print(
+            "wrote dispatch budgets "
+            f"(fused {dledger['fused']['max_dispatches_per_level']}, "
+            f"staged {dledger['staged']['max_dispatches_per_level']} "
+            "programs/level) to "
+            f"{dispatch_audit.DISPATCH_LEDGER_PATH}"
+        )
         return 0
     if not args.no_jaxpr:
         golden = jaxpr_audit.load_golden(args.ledger)
@@ -80,6 +93,13 @@ def main(argv=None) -> int:
             print(f"--ledger {args.ledger}: no such file", file=sys.stderr)
             return 2
         failures, warnings = jaxpr_audit.audit(golden)
+    if not args.no_jaxpr and not args.no_dispatch:
+        # GL011: per-level device-dispatch budgets (fused + staged) —
+        # measured engine runs, so it rides the same "needs jax" gate
+        # as the jaxpr layer plus its own --no-dispatch opt-out
+        d_fail, d_warn = dispatch_audit.audit()
+        failures += d_fail
+        warnings += d_warn
 
     for f in findings:
         print(f.format())

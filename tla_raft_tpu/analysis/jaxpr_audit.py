@@ -57,13 +57,19 @@ COLLECTIVE_PRIMITIVES = {
 _NARROW_KEY = "convert_element_type[narrow64]"
 
 # GL010: the kernels under the data-indexed gather/scatter budget —
-# the per-level expand hot path (both MXU and legacy A/B variants)
+# the per-level expand hot path (both MXU and legacy A/B variants),
+# plus the fused whole-level program (engine/megakernel.py): its MXU
+# expand/materialize stages contribute ZERO data-indexed gathers; the
+# ledgered budget pins the residue (hashstore probe rounds + the
+# materialize parent-row gathers) so fusion can never smuggle the
+# gather storm back in
 GL010_KERNELS = (
     "successor.expand_guards",
     "successor.materialize",
     "successor.expand_guards_legacy",
     "successor.materialize_legacy",
     "dense.expand",
+    "engine.megakernel_level",
 )
 
 
@@ -97,6 +103,7 @@ def kernel_registry():
     import jax
     import jax.numpy as jnp
 
+    from ..engine import megakernel as megakernel_mod
     from ..models.raft import init_batch
     from ..ops import hashstore
     from ..ops.successor import get_kernel
@@ -148,6 +155,12 @@ def kernel_registry():
             lambda: jax.make_jaxpr(hashstore.probe_and_insert_impl)(
                 slab, fps, fps, pays
             ),
+        # the fused whole-level program (engine/megakernel.py): expand
+        # while_loop + probe-and-insert + materialize scan + invariant
+        # reduce as ONE jaxpr — registered so the fusion's primitive
+        # mix is frozen like every other hot kernel's
+        "engine.megakernel_level":
+            lambda: megakernel_mod.ledger_trace(cfg),
     }
 
 

@@ -84,6 +84,70 @@ def thread_compiles_declared() -> bool:
     return getattr(_tl, "declared_compiles", False)
 
 
+class DispatchLog:
+    """Lightweight per-level engine-dispatch counter.
+
+    The engines note every device PROGRAM dispatch of their level loops
+    at the call site (choke-point accounting, like the GL006 host-sync
+    ledger — eager op dispatches are out of scope by design), and tick
+    the level boundary through :func:`level_tick`.  Consumed by the
+    GL011 dispatch-budget audit (analysis/dispatch_audit.py) and the
+    bench's dispatches/level report without arming the full Sanitizer.
+    """
+
+    def __init__(self):
+        self.total = 0
+        self._cur = 0
+        self.per_level: list[int] = []
+        self.tags: dict[str, int] = {}
+
+    def note(self, tag: str) -> None:
+        self.total += 1
+        self._cur += 1
+        self.tags[tag] = self.tags.get(tag, 0) + 1
+
+    def tick(self) -> None:
+        self.per_level.append(self._cur)
+        self._cur = 0
+
+    def close(self) -> None:
+        """Fold a trailing partial level (the fixpoint-discovery level
+        never reaches the engine's tick) into the ledger."""
+        if self._cur:
+            self.tick()
+
+    def steady_max(self, warmup: int = 2) -> int:
+        """Worst dispatches/level past the compile-warmup prefix."""
+        per = self.per_level[warmup:] or self.per_level
+        return max(per) if per else 0
+
+
+_DISPATCH_SINK: DispatchLog | None = None
+
+
+def set_dispatch_sink(sink: DispatchLog | None) -> None:
+    """Attach a :class:`DispatchLog` (bench / GL011 measurement)."""
+    global _DISPATCH_SINK
+    _DISPATCH_SINK = sink
+
+
+def dispatch_sink() -> DispatchLog | None:
+    return _DISPATCH_SINK
+
+
+def tracking() -> bool:
+    """Is any per-level ledger (sanitizer or dispatch sink) active?"""
+    return CURRENT is not None or _DISPATCH_SINK is not None
+
+
+def note_dispatch(tag: str) -> None:
+    """Engines note one device-program dispatch of the level loop."""
+    if CURRENT is not None:
+        CURRENT.note_dispatch(tag)
+    if _DISPATCH_SINK is not None:
+        _DISPATCH_SINK.note(tag)
+
+
 def note_async_fetch_start() -> None:
     """The async pipeline started one fetch group (copy_to_host_async)."""
     if CURRENT is not None:
@@ -102,6 +166,8 @@ def level_tick() -> None:
     """Engines call this once per completed BFS level."""
     if CURRENT is not None:
         CURRENT.level_tick()
+    if _DISPATCH_SINK is not None:
+        _DISPATCH_SINK.tick()
 
 
 def note_shape_event(reason: str) -> None:
@@ -149,6 +215,15 @@ class Sanitizer:
         self.ledgered_bytes = 0
         self.n_implicit = 0
         self.n_worker_dispatch = 0
+        # per-level engine-program dispatch/fetch ledger: the engines
+        # note every level-loop device program at its call site and the
+        # level boundary snapshots both counters — the GL011 budget and
+        # the megakernel's one-dispatch/one-fetch smoke read these
+        self.n_dispatches = 0
+        self._level_dispatches = 0
+        self._gets_at_tick = 0
+        self.per_level_dispatches: list[int] = []
+        self.per_level_gets: list[int] = []
         # async-pipeline fetch groups (engine/pipeline.py): every
         # copy_to_host_async group must complete through the ledgered
         # device_get path — started minus completed is the count of
@@ -314,8 +389,10 @@ class Sanitizer:
         CURRENT = None
 
     def __exit__(self, *exc):
-        # close the final (partial) level's accounting
-        if self._level_compiles:
+        # close the final (partial) level's accounting — the fixpoint-
+        # discovery level dispatches and fetches but never reaches the
+        # engine's tick (it breaks on n_new == 0)
+        if self._level_compiles or self._level_dispatches:
             self.level_tick()
         self._disarm()
         return False
@@ -325,7 +402,20 @@ class Sanitizer:
     def note_shape_event(self, reason: str) -> None:
         self._level_events.append(reason)
 
+    def note_dispatch(self, tag: str) -> None:
+        self.n_dispatches += 1
+        self._level_dispatches += 1
+
+    def _steady(self, per_level: list[int]) -> list[int]:
+        return per_level[self.warmup_levels:] or per_level
+
     def level_tick(self) -> None:
+        self.per_level_dispatches.append(self._level_dispatches)
+        self._level_dispatches = 0
+        self.per_level_gets.append(
+            self.n_ledgered_get - self._gets_at_tick
+        )
+        self._gets_at_tick = self.n_ledgered_get
         self.level += 1
         excused = bool(self._level_events) or self._grace > 0
         # a shape event declared in level N excuses level N+1 as well:
@@ -372,6 +462,8 @@ class Sanitizer:
         )
 
     def report(self) -> dict:
+        sd = self._steady(self.per_level_dispatches)
+        sg = self._steady(self.per_level_gets)
         return dict(
             ok=self.ok,
             levels=self.level,
@@ -386,6 +478,11 @@ class Sanitizer:
             async_fetches=self.n_async_completed,
             unledgered_async_fetches=self.unledgered_async_fetches,
             worker_thread_dispatches=self.n_worker_dispatch,
+            engine_dispatches=self.n_dispatches,
+            per_level_dispatches=list(self.per_level_dispatches),
+            per_level_fetches=list(self.per_level_gets),
+            steady_max_dispatches_per_level=max(sd) if sd else 0,
+            steady_max_fetches_per_level=max(sg) if sg else 0,
             violations=list(self.violations),
         )
 
@@ -411,6 +508,14 @@ class Sanitizer:
             f"Sanitizer: {r['async_fetches']} async pipeline fetches "
             f"({r['unledgered_async_fetches']} unledgered), "
             f"{r['prewarm_compiles']} declared prewarm compiles.",
+            file=out,
+        )
+        print(
+            f"Sanitizer: {r['engine_dispatches']} engine program "
+            f"dispatches; steady-state max "
+            f"{r['steady_max_dispatches_per_level']} dispatch(es) and "
+            f"{r['steady_max_fetches_per_level']} ledgered fetch(es) "
+            "per level.",
             file=out,
         )
         for v in r["violations"]:

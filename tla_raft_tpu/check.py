@@ -222,6 +222,7 @@ def summarize(res, chk=None, seconds: float | None = None) -> dict:
         # uninterrupted run's, level by level
         level_sizes=list(res.level_sizes),
         mxu=getattr(chk, "use_mxu", None),
+        megakernel=getattr(chk, "megakernel", None),
         seconds=round(seconds, 3) if seconds is not None else None,
         violation=res.violation[0] if res.violation else None,
     )
@@ -264,6 +265,7 @@ def run_check(
     pipeline_window: int | None = None,
     prewarm: bool | None = None,
     use_mxu: bool | None = None,
+    megakernel: bool | None = None,
     audit: int = 0,
     audit_retries: int = 3,
     watchdog: float = 0.0,
@@ -438,6 +440,7 @@ def run_check(
                     pipeline=pipeline,
                     pipeline_window=pipeline_window,
                     use_mxu=use_mxu,
+                    megakernel=megakernel,
                     prewarm=prewarm,
                     audit=audit,
                     audit_retries=audit_retries,
@@ -585,6 +588,16 @@ def main(argv=None) -> int:
                         "env: TLA_RAFT_MXU")
     p.add_argument("--no-mxu-expand", action="store_true",
                    help="shorthand for --mxu-expand 0")
+    p.add_argument("--megakernel", type=int, choices=(0, 1), default=None,
+                   help="whole-level megakernel: fuse expand -> "
+                        "probe-and-insert -> materialize -> invariant "
+                        "scan into ONE jitted program per level with one "
+                        "ledgered control fetch (engine/megakernel.py). "
+                        "Default on; 0 reverts to the staged program "
+                        "chain (A/B — counts are bit-identical). "
+                        "Single-device engine; the external-store path "
+                        "fuses expand+dedup per group. env: "
+                        "TLA_RAFT_MEGAKERNEL")
     p.add_argument("--no-hashstore", action="store_true",
                    help="revert to the sort-based visited path (lexsort "
                         "+ searchsorted + sorted merge) instead of the "
@@ -706,6 +719,9 @@ def main(argv=None) -> int:
                 None if args.prewarm is None else bool(args.prewarm)
             ),
             use_mxu=_mxu_arg(args),
+            megakernel=(
+                None if args.megakernel is None else bool(args.megakernel)
+            ),
             audit=args.audit,
             audit_retries=args.audit_retries,
             watchdog=args.watchdog,

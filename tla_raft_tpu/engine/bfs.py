@@ -71,6 +71,7 @@ from ..config import RaftConfig
 from ..models.raft import RaftState, init_batch, to_oracle
 from ..ops import hashstore
 from ..ops.successor import SuccessorKernel, get_kernel
+from . import megakernel as graft_megakernel
 from . import pipeline as graft_pipeline
 from .forecast import MIN_LEVELS as PRESIZE_MIN_LEVELS, pow2ceil as _pow2
 from .invariants import resolve_invariant_kernel
@@ -391,8 +392,7 @@ def _level_dedup(cv, cf, cp, visited):
     )
 
 
-@jax.jit
-def _group_unique(cv, cf, cp):
+def _group_unique_impl(cv, cf, cp):
     """Intra-group dedup for the external-store path.
 
     Picks the min-(fp_full, payload) representative per view fingerprint
@@ -418,6 +418,9 @@ def _group_unique(cv, cf, cp):
         jnp.where(pref, sf[comp], SENT),
         jnp.where(pref, sp[comp], -1),
     )
+
+
+_group_unique = jax.jit(_group_unique_impl)
 
 
 @jax.jit
@@ -458,6 +461,7 @@ class JaxChecker:
         pipeline_window: int | None = None,
         prewarm: bool | None = None,
         use_mxu: bool | None = None,
+        megakernel: bool | None = None,
         audit: int = 0,
         audit_retries: int = 3,
         watchdog=None,
@@ -624,6 +628,30 @@ class JaxChecker:
         self.orbit = bool(int(env_orb)) if env_orb else False
         if self.orbit and canon != "late":
             raise ValueError("TLA_RAFT_ORBIT requires canon='late'")
+        # whole-level megakernel (engine/megakernel.py): expand ->
+        # probe-and-insert -> materialize -> invariant scan fused into
+        # ONE jitted program per level, with one ledgered control fetch.
+        # Default ON; --megakernel 0 / TLA_RAFT_MEGAKERNEL=0 reverts to
+        # the staged program chain (retained as the A/B and audit
+        # reference — counts are bit-identical either way).  The fused
+        # program needs the functional hash store (its probe-and-insert
+        # IS the dedup stage) and the single-program orbit split is
+        # structurally incompatible; the host-store path gets the
+        # partial fusion (expand span + group dedup in one program —
+        # everything up to the host-store probe) under the same flag.
+        if megakernel is None:
+            megakernel = graft_megakernel.enabled_by_env()
+        self._mega_flag = bool(megakernel) and not self.orbit
+        self.megakernel = (
+            self._mega_flag and self.use_hashstore and host_store is None
+        )
+        self._mega_donate = (
+            self.megakernel and graft_megakernel.donation_supported()
+        )
+        self._mega_stats = dict(
+            levels=0, redo_out=0, redo_x=0, redo_slab=0, redo_m=0,
+        )
+        self._degraded_visited = None  # sorted store handoff on degrade
         # semantic run fingerprint for the checkpoint manifests: spec
         # constants only — NOT tunables like chunk (a resume may retune
         # those freely), NOT the store tier (the three tiers share one
@@ -671,6 +699,16 @@ class JaxChecker:
             self._expand_chunk = self._expand_chunk_split
         else:
             self._expand_chunk = jax.jit(self._expand_chunk_impl)
+        # the fused whole-level program (and the host path's fused
+        # span+dedup slice) close over cap_x — rebuild with it
+        if getattr(self, "megakernel", False):
+            self._mega_prog = graft_megakernel.level_program_for(
+                self, self._mega_donate
+            )
+        if getattr(self, "_mega_flag", False) and not self.orbit:
+            self._expand_group_fused = jax.jit(
+                self._expand_group_fused_impl
+            )
 
     # -- sparse <-> dense message-set conversion ---------------------------
 
@@ -970,6 +1008,188 @@ class JaxChecker:
             body, init, jnp.arange(self.G, dtype=I64)
         )
         return cvs, cfs, cps, mult, ab, ovf
+
+    def _expand_group_fused_impl(self, seg, slice_base, global_base, n_f):
+        """Span + intra-group dedup in ONE program — the host-store
+        path's megakernel slice (everything up to the host-store probe
+        fuses; the probe itself lives host-side by design).  Identical
+        outputs to ``_expand_span`` followed by ``_group_unique``: the
+        dedup body is the SAME ``_group_unique_impl``."""
+        cvs, cfs, cps, mult, ab, ovf = self._expand_span_impl(
+            seg, slice_base, global_base, n_f
+        )
+        n_u, gv, gf, gp = _group_unique_impl(
+            cvs.reshape(-1), cfs.reshape(-1), cps.reshape(-1)
+        )
+        return n_u, gv, gf, gp, mult, ab, ovf
+
+    # -- whole-level megakernel (engine/megakernel.py) ---------------------
+
+    def _mega_level_ok(self, frontier, n_f) -> bool:
+        """Is this level eligible for the fused whole-level program?
+
+        Grouped ultra-deep levels keep the staged path: there the group
+        filter's visited pre-probe bounds the candidate working set to
+        O(groups * cap_g) before the level-wide buffers exist, which is
+        the memory regime the grouping threshold was tuned for."""
+        if not self.megakernel or not self.use_hashstore:
+            return False
+        if isinstance(frontier, list) or self.host_store is not None:
+            return False
+        n_chunks = -(-max(n_f, 1) // self.chunk)
+        return n_chunks <= 16 * self.G
+
+    def _mega_cap_out(self, n_f, level_sizes, max_depth, n_lanes,
+                      floor):
+        """The fused program's static new-frontier capacity: forecast
+        when there is signal (the same 1.25 margin the prewarm ladder
+        bakes in, so the AOT-compiled rung is the one requested), the
+        early fan-out bound (growth ratios stay under 4 on this family)
+        otherwise, quantized through the one frontier-capacity ladder.
+        ``floor`` carries an exact redo bound (n_new from the control
+        fetch); n_new can never exceed the candidate lane budget, so
+        clamping at ``n_lanes`` makes the ladder's top rung overflow-
+        free."""
+        from .forecast import MIN_LEVELS, forecast_new_states
+
+        est = 0
+        if len(level_sizes) > MIN_LEVELS:
+            fut = forecast_new_states(level_sizes, max_depth)
+            if fut:
+                # the 2x floor covers forecast undershoot through the
+                # whole sub-2x-growth regime: dead output lanes cost
+                # nothing (the materialize scan skips whole-dead
+                # slices), a redo costs a full level
+                est = max(int(fut[0] * 1.25) + 1, 2 * max(n_f, 1))
+        if not est:
+            est = 4 * max(n_f, 1)
+        est = max(est, floor)
+        # the quantizer keeps every capacity a chunk multiple >= chunk;
+        # clamping the ESTIMATE (not the result) at the lane budget
+        # keeps the ladder's top rung overflow-free without ever
+        # violating that invariant (the kernel pads when cap_out
+        # exceeds the lane count — tiny cap_x configs).  The 4*chunk
+        # floor mirrors the staged payload width (max(_pow2(n_new),
+        # 4*chunk)): levels below it share ONE program shape instead of
+        # stepping through every tiny rung — compile count, not memory,
+        # is the cost down there (dead slices are cond-skipped)
+        return max(
+            self._frontier_cap(min(est, max(n_lanes, 1))),
+            4 * self.chunk,
+        )
+
+    def _expand_level_mega(self, frontier, n_f, max_depth, level_sizes):
+        """One fused device program + ONE ledgered fetch for a whole
+        level.  Every overflow class re-enters the engine's existing
+        grow-and-redo machinery against the ORIGINAL slab (the pending
+        slab is discarded; the kernels are functional).  Returns None
+        when the hash store degraded mid-level (the caller adopts the
+        rebuilt sorted store from ``_degraded_visited`` and redoes the
+        level staged), else the level-result dict; the pending slab
+        lands in ``_hs_pending`` for the common adopt path."""
+        mk = graft_megakernel
+        n_f_dev = jnp.asarray(n_f, I64)
+        out_floor = 0
+        while True:
+            cap_f = frontier.voted_for.shape[0]
+            n_lanes = (cap_f // self.chunk) * self.cap_x
+            cap_out = self._mega_cap_out(
+                n_f, level_sizes, max_depth, n_lanes, out_floor
+            )
+            # re-resolve through the shared cache EVERY attempt: the
+            # staleness guard compares this engine's budgets against
+            # the cached creator's, so a creator that grew cap_x/cap_m
+            # after we borrowed its program can never hand us a trace
+            # against its mutated state (a dict hit costs nothing)
+            self._mega_prog = graft_megakernel.level_program_for(
+                self, self._mega_donate
+            )
+            outs = self._mega_prog(
+                frontier, self.hstore.slab, n_f_dev, cap_out=cap_out
+            )
+            if self._mega_donate:
+                (new_frontier, slab2, ctrl_d, mult_d, fps_d, pidx_d,
+                 slot_d, frontier) = outs
+            else:
+                (new_frontier, slab2, ctrl_d, mult_d, fps_d, pidx_d,
+                 slot_d) = outs
+            graft_sanitize.note_dispatch("megakernel.level")
+            self._san_lanes = n_lanes
+            # THE level fetch: control vector + trace/delta arrays in
+            # one ledgered get, routed through the pipeline's deferred
+            # path (transfer ledger, pipeline.window fault site and the
+            # watchdog heartbeat all still see it)
+            tail = graft_pipeline.DeferredFetch(
+                self.pipeline, (ctrl_d, mult_d, fps_d, pidx_d, slot_d)
+            )
+            ctrl, mult_np, fps_np, pidx_np, slot_np = tail.get()
+            ctrl = np.asarray(ctrl, np.int64)
+            n_new = int(ctrl[mk.CTRL_N_NEW])
+            if ctrl[mk.CTRL_OVF_SLAB]:
+                self._hs_pending = None
+                try:
+                    self.hstore.grow()
+                except Exception as e:  # graftlint: waive[GL003] — any
+                    # grow failure (device OOM, injected fault) degrades
+                    # to the sort path; the level redoes staged.  The
+                    # degrade result MUST carry the pass-through parent:
+                    # under donation the caller's frontier buffers were
+                    # consumed by the dispatch above, and the staged
+                    # redo would otherwise expand a deleted array
+                    self._degraded_visited = self._degrade_hashstore(e)
+                    return dict(degraded=True, parent=frontier)
+                self._mega_stats["redo_slab"] += 1
+                continue
+            if ctrl[mk.CTRL_OVF_X]:
+                # a chunk overflowed its compaction budget: the same
+                # half-step growth + re-jit as the staged redo
+                self.cap_x = _cap_steps(self.cap_x + 1)
+                self.cap_g = max(self.cap_g, self.G * self.cap_x // 2)
+                self._jit_expand_programs()
+                self._mega_stats["redo_x"] += 1
+                continue
+            if n_new > cap_out:
+                # exact capacity is now known — one redo lands it
+                out_floor = n_new
+                self._mega_stats["redo_out"] += 1
+                continue
+            if int(ctrl[mk.CTRL_ABORT]) < n_f:
+                break  # violation: counts are final, nothing is adopted
+            if ctrl[mk.CTRL_OVF_M] and n_new:
+                if self.cap_m >= self.kern.uni.M:
+                    raise RuntimeError(
+                        "message-set width exceeds the whole universe — "
+                        "corrupt payloads?"
+                    )
+                self.cap_m = min(self.cap_m + 32, self.kern.uni.M)
+                print(
+                    f"[engine] cap_m overflow: growing to {self.cap_m} "
+                    "and redoing the fused level", file=sys.stderr,
+                )
+                frontier = self._widen_msg_ids(frontier)
+                # re-resolve the fused program under the grown cap_m:
+                # the widened shapes drive the retrace, but the shared
+                # program cache keys on cap_m, so a stale binding from
+                # another engine's key must not be retraced through
+                self._jit_expand_programs()
+                self._mega_stats["redo_m"] += 1
+                continue
+            break
+        self._hs_pending = slab2
+        self._mega_stats["levels"] += 1
+        return dict(
+            n_new=n_new,
+            abort_at=int(ctrl[mk.CTRL_ABORT]),
+            bad_idx=int(ctrl[mk.CTRL_BAD]),
+            slab_live=int(ctrl[mk.CTRL_SLAB_LIVE]),
+            level_mult=np.asarray(mult_np, np.int64),
+            new_frontier=new_frontier,
+            parent=frontier,
+            fps=np.asarray(fps_np, np.uint64)[:n_new],
+            pidx=np.asarray(pidx_np)[:n_new].astype(np.int64),
+            slot=np.asarray(slot_np)[:n_new].astype(np.int64),
+            cap_out=cap_out,
+        )
 
     def _inv_scan_impl(self, children: RaftState, n_valid):
         """All configured invariants over a level; (first_bad_idx|-1)."""
@@ -1323,6 +1543,7 @@ class JaxChecker:
             ch_f, bad_d, ovf_d = self._mat_slice(
                 frontier, pay_slice, jnp.asarray(take, I64)
             )
+            graft_sanitize.note_dispatch("device.mat")
             child_parts.append(ch_f)
             bad_ds.append(bad_d)
             ovf_ds.append(ovf_d)
@@ -1434,6 +1655,64 @@ class JaxChecker:
         def i64(n):
             return jax.ShapeDtypeStruct((n,), jnp.int64)
 
+        def slab_ladder():
+            # the ONE slab-capacity ladder both the fused and staged
+            # hashstore plans rung through (drift here would split
+            # their compiled shapes)
+            return pow2_ladder(
+                self.hstore.cap // 2, hashstore.slab_rows(final)
+            ) or [self.hstore.cap]
+
+        # forecast rows the FUSED program will serve: the prefix whose
+        # levels stay under the grouping threshold (the level loop
+        # routes bigger levels to the staged grouped path, so those
+        # rows need the staged plan below instead)
+        mega_rows = 0
+        if self._mega_level_ok(frontier, max(int(rows[0]), 1)):
+            prev = max(int(level_sizes[-1]), 1)
+            for r in rows:
+                if -(-max(prev, 1) // self.chunk) > 16 * self.G:
+                    break
+                mega_rows += 1
+                prev = int(r)
+        if mega_rows:
+            # fused path: the megakernel ladder replaces the staged
+            # span/dedup/gfilter program set for these rows — each
+            # forecast level's program is keyed by (input cap, output
+            # cap, slab cap): the input rung chains from the previous
+            # level's output (the fused program's new frontier IS the
+            # next level's input), the output rung runs through the
+            # SAME capacity function as the runtime _mega_cap_out
+            # (shape_plan's rows are already 1.25-margined; the 2x
+            # floor, lane clamp and 4*chunk floor match), and the slab
+            # ladder follows the store's growth.
+            scaps = slab_ladder()
+            prev_cap = frontier.voted_for.shape[0]
+            prev_rows = max(int(level_sizes[-1]), 1)
+            for r in rows[:mega_rows]:
+                n_lanes = (prev_cap // self.chunk) * self.cap_x
+                est = max(int(r), 2 * prev_rows)
+                cout = max(
+                    self._frontier_cap(min(est, max(n_lanes, 1))),
+                    4 * self.chunk,
+                )
+                fs = self._frontier_struct(frontier, prev_cap)
+                for sc in scaps:
+                    plan.append((
+                        ("mega", prev_cap, cout, sc, self.cap_x,
+                         self.cap_m, self.use_mxu),
+                        lambda fs=fs, sc=sc, cout=cout:
+                            self._mega_prog.lower(
+                                fs, u64(sc), s_i64, cap_out=cout
+                            ).compile(),
+                    ))
+                prev_cap, prev_rows = cout, int(r)
+            if mega_rows == len(rows):
+                return plan
+            # later rows cross into the grouped regime: fall through so
+            # the staged span/dedup/gfilter ladder compiles ahead of
+            # the regime switch too
+
         # 1) the expand-span program at the frontier-capacity ladder (the
         # big one: its compile is the round-3 minutes-class cost).  The
         # external-store path walks uniform SEG_ROWS segments once the
@@ -1470,9 +1749,7 @@ class JaxChecker:
             else:
                 lanes.add(_cap_steps(n_chunks * self.cap_x))
         if self.use_hashstore:
-            scaps = pow2_ladder(
-                self.hstore.cap // 2, hashstore.slab_rows(final)
-            ) or [self.hstore.cap]
+            scaps = slab_ladder()
             for sc in scaps:
                 for L in sorted(lanes):
                     plan.append((
@@ -1630,6 +1907,7 @@ class JaxChecker:
                 par(j), par(min(j + 1, n_par - 1)),
                 jnp.asarray(j * L, I64), pay_slice, jnp.asarray(take, I64),
             )
+            graft_sanitize.note_dispatch("device.mat_seg")
             parts_buf.append(part)
             if len(parts_buf) == per_seg or si == n_slices - 1:
                 # seal one destination segment: a bounded concat (the
@@ -1709,6 +1987,7 @@ class JaxChecker:
             ch_f, bad_d, ovf_d = self._mat_slice(
                 whole, pay_slice, jnp.asarray(take, I64)
             )
+            graft_sanitize.note_dispatch("device.mat")
             parts_buf.append(ch_f)
             if len(parts_buf) == per_seg or si == n_slices - 1:
                 dj = min((si * sl) // seg_d, n_seg_d - 1)
@@ -2155,6 +2434,9 @@ class JaxChecker:
         self.use_hashstore = False
         self.hstore = None
         self._hs_pending = None
+        # the fused level program IS a hash-store consumer — the sorted
+        # path runs staged for the rest of the run
+        self.megakernel = False
         return visited
 
     def _check_fp_def(self, fp_def: int, path: str) -> None:
@@ -2245,6 +2527,7 @@ class JaxChecker:
 
         def gfilter(av, af, ap):
             """Visited filter for one group: hash probe or searchsorted."""
+            graft_sanitize.note_dispatch("device.gfilter")
             if use_hs:
                 return _group_filter_hash(av, af, ap, hslab, self.cap_g)
             return _group_filter(av, af, ap, visited, self.cap_g)
@@ -2306,6 +2589,7 @@ class JaxChecker:
                 cvs_s, cfs_s, cps_s, mult_s, ab_s, ovf_s = self._expand_span(
                     frontier, b, b, n_f_dev
                 )
+                graft_sanitize.note_dispatch("device.span")
                 mult_acc = mult_acc + mult_s
                 abort_at = jnp.minimum(abort_at, ab_s)
                 overflow = overflow | ovf_s
@@ -2338,6 +2622,7 @@ class JaxChecker:
                 jnp.asarray(start, I64),
                 n_f_dev,
             )
+            graft_sanitize.note_dispatch("device.chunk")
             cvs.append(cv)
             cfs.append(cf)
             cps.append(cp)
@@ -2392,6 +2677,7 @@ class JaxChecker:
                 jnp.concatenate(lvs), jnp.concatenate(lfs),
                 jnp.concatenate(lps), hslab,
             )
+            graft_sanitize.note_dispatch("device.dedup_hash")
             self._hs_pending = slab2
         else:
             ovf_h = jnp.zeros((), bool)
@@ -2399,6 +2685,7 @@ class JaxChecker:
                 jnp.concatenate(lvs), jnp.concatenate(lfs),
                 jnp.concatenate(lps), visited,
             )
+            graft_sanitize.note_dispatch("device.dedup")
         # ONE host sync for the level's control state
         n_new, ab, ovf, ovf_g, ovf_hs, mult_np = jax.device_get(
             (n_new_dev, abort_at, overflow, overflow_g, ovf_h, mult_acc)
@@ -2522,7 +2809,21 @@ class JaxChecker:
                 and (gi + 1) * G <= n_chunks
                 and g_lo // seg_len == (g_hi - 1) // seg_len
             )
-            if span_ok:
+            fused = None
+            if span_ok and self._mega_flag:
+                # megakernel slice of the host-store path: span expand +
+                # intra-group dedup in ONE program per group — the level
+                # is then one dispatch + one fetch per group up to the
+                # host-store probe
+                sj, off = divmod(g_lo, seg_len)
+                (n_u_dev, gv, gf, gp, mult_acc, abort_at,
+                 overflow) = self._expand_group_fused(
+                    seg_dev(sj), jnp.asarray(off, I64),
+                    jnp.asarray(g_lo, I64), n_f_dev,
+                )
+                graft_sanitize.note_dispatch("host.group_fused")
+                fused = True
+            elif span_ok:
                 sj, off = divmod(g_lo, seg_len)
                 cvs_s, cfs_s, cps_s, mult_acc, abort_at, overflow = (
                     self._expand_span(
@@ -2530,6 +2831,7 @@ class JaxChecker:
                         jnp.asarray(g_lo, I64), n_f_dev,
                     )
                 )
+                graft_sanitize.note_dispatch("host.span")
                 cat_v, cat_f, cat_p = (
                     cvs_s.reshape(-1), cfs_s.reshape(-1), cps_s.reshape(-1)
                 )
@@ -2547,6 +2849,7 @@ class JaxChecker:
                     cv, cf, cp, mult_slots, ab_at, ovf = self._expand_chunk(
                         part_f, jnp.asarray(ci * self.chunk, I64), n_f_dev
                     )
+                    graft_sanitize.note_dispatch("host.chunk")
                     cvs.append(cv)
                     cfs.append(cf)
                     cps.append(cp)
@@ -2564,7 +2867,9 @@ class JaxChecker:
                 cat_v = jnp.concatenate(cvs)
                 cat_f = jnp.concatenate(cfs)
                 cat_p = jnp.concatenate(cps)
-            n_u_dev, gv, gf, gp = _group_unique(cat_v, cat_f, cat_p)
+            if fused is None:
+                n_u_dev, gv, gf, gp = _group_unique(cat_v, cat_f, cat_p)
+                graft_sanitize.note_dispatch("host.unique")
             # submit the FIXED-shape padded buffers to the fetch window
             # (host-side slicing: a device-side gv[:n_u] slice would
             # compile a fresh tiny program per distinct n_u — one remote
@@ -2950,8 +3255,35 @@ class JaxChecker:
                 self._submit_prewarm(
                     level_sizes, distinct, max_depth, frontier, visited
                 )
-            # --- expand + compact-then-dedup (device), fused level fetch -
-            while True:
+            # --- whole-level megakernel: ONE fused program + ONE
+            # ledgered fetch per level (engine/megakernel.py); every
+            # overflow redoes inside, a mid-level hash-store
+            # degradation falls through to the staged path below -----
+            mres = None
+            if self._mega_level_ok(frontier, n_f):
+                mres = self._expand_level_mega(
+                    frontier, n_f, max_depth, level_sizes
+                )
+                if mres is not None and mres.get("degraded"):
+                    # hash store degraded mid-level: adopt the rebuilt
+                    # sorted store, rebind the (donation pass-through)
+                    # parent and redo the level staged
+                    frontier = mres["parent"]
+                    visited = self._degraded_visited
+                    self._degraded_visited = None
+                    mres = None
+            if mres is not None:
+                n_new = mres["n_new"]
+                abort_at = mres["abort_at"]
+                level_mult = mres["level_mult"]
+                # under donation the parent came back as the aliased
+                # pass-through output; rebind so redo/audit/trace all
+                # see a live buffer
+                frontier = mres["parent"]
+                new_fps = new_payload = None
+            # --- staged fallback: expand + compact-then-dedup (device),
+            # fused level fetch ------------------------------------------
+            while mres is None:
                 (n_new, new_fps, new_payload, abort_at, overflow, overflow_g,
                  overflow_h, level_mult) = self._expand_level(
                     frontier, n_f, visited,
@@ -3018,50 +3350,61 @@ class JaxChecker:
                     self._wipe_partials(checkpoint_dir)
                 break
 
-            # --- materialize the survivors (device-resident) ------------
-            # slice width must not exceed the payload capacity (a custom
-            # cap_x < 4*chunk shrinks the dedup output below 4*chunk).
-            # The new frontier comes back fully built at its quantized
-            # capacity (donated in-place slice writes — the parent, the
-            # slices AND the concat result never coexist)
-            new_frontier, bads, n_slices, sl, frontier = (
-                self._materialize_grow(
-                    frontier, new_payload, n_new, pay_np=pay_host
-                )
-            )
-            # trace spill: the external-store path already holds the
-            # payloads host-side — no device round-trip there.  The
-            # device path submits its level-tail fetch (trace arrays +
-            # the delta record's fps slice) to the async window instead
-            # of blocking here, so the ~24 B/state tail crosses the host
-            # link WHILE the store merge below runs on the device
-            # (window 0 = the serial fetch-in-place chain).
             tail = None
-            if pay_host is not None:
-                pidx_np = (pay_host // K).astype(np.int64)
-                slot_np = (pay_host % K).astype(np.int64)
+            if mres is not None:
+                # the fused program already materialized the level and
+                # fetched its trace/delta arrays in the one control get
+                new_frontier = mres["new_frontier"]
+                pidx_np = mres["pidx"]
+                slot_np = mres["slot"]
+                bad_idx = mres["bad_idx"]
             else:
-                pidx32 = (new_payload[: n_slices * sl] // K).astype(U32C)
-                # fetch width must match _save_delta's: a u16 cast here
-                # would wrap slots at K > 65535 before the widened save
-                # ever saw them
-                slot_jdt = jnp.uint16 if K <= 0xFFFF else jnp.uint32
-                slot16 = (new_payload[: n_slices * sl] % K).astype(slot_jdt)
-                tree = [pidx32, slot16]
-                if checkpoint_dir and checkpoint_every:
-                    # the delta record's fps (pow2-quantized device
-                    # slice, host trim — see the checkpoint block)
-                    w_ck = min(new_fps.shape[0],
-                               max(_pow2(n_new), self.chunk))
-                    tree.append(new_fps[:w_ck])
-                tail = graft_pipeline.DeferredFetch(
-                    self.pipeline, tuple(tree)
+                # --- materialize the survivors (device-resident) --------
+                # slice width must not exceed the payload capacity (a
+                # custom cap_x < 4*chunk shrinks the dedup output below
+                # 4*chunk).  The new frontier comes back fully built at
+                # its quantized capacity (donated in-place slice writes —
+                # the parent, the slices AND the concat result never
+                # coexist)
+                new_frontier, bads, n_slices, sl, frontier = (
+                    self._materialize_grow(
+                        frontier, new_payload, n_new, pay_np=pay_host
+                    )
                 )
-            bad_idx = -1
-            for si, b in enumerate(bads):
-                if b >= 0:
-                    bad_idx = si * sl + int(b)
-                    break
+                # trace spill: the external-store path already holds the
+                # payloads host-side — no device round-trip there.  The
+                # device path submits its level-tail fetch (trace arrays +
+                # the delta record's fps slice) to the async window instead
+                # of blocking here, so the ~24 B/state tail crosses the host
+                # link WHILE the store merge below runs on the device
+                # (window 0 = the serial fetch-in-place chain).
+                if pay_host is not None:
+                    pidx_np = (pay_host // K).astype(np.int64)
+                    slot_np = (pay_host % K).astype(np.int64)
+                else:
+                    pidx32 = (new_payload[: n_slices * sl] // K).astype(U32C)
+                    # fetch width must match _save_delta's: a u16 cast here
+                    # would wrap slots at K > 65535 before the widened save
+                    # ever saw them
+                    slot_jdt = jnp.uint16 if K <= 0xFFFF else jnp.uint32
+                    slot16 = (
+                        new_payload[: n_slices * sl] % K
+                    ).astype(slot_jdt)
+                    tree = [pidx32, slot16]
+                    if checkpoint_dir and checkpoint_every:
+                        # the delta record's fps (pow2-quantized device
+                        # slice, host trim — see the checkpoint block)
+                        w_ck = min(new_fps.shape[0],
+                                   max(_pow2(n_new), self.chunk))
+                        tree.append(new_fps[:w_ck])
+                    tail = graft_pipeline.DeferredFetch(
+                        self.pipeline, tuple(tree)
+                    )
+                bad_idx = -1
+                for si, b in enumerate(bads):
+                    if b >= 0:
+                        bad_idx = si * sl + int(b)
+                        break
             # the audit re-expands sampled rows from their PARENTS, so
             # the pre-swap frontier must outlive the swap (audit runs
             # only; production keeps the old drop-at-swap lifetime)
@@ -3087,6 +3430,14 @@ class JaxChecker:
                 # mid-level overflow redos stay the rare backstop
                 self.hstore.adopt(self._hs_pending, n_new)
                 self._hs_pending = None
+                if mres is not None:
+                    # free conservation check: the fused program counted
+                    # the pending slab's live slots in its control
+                    # vector — they must equal the distinct set exactly
+                    resilience.integrity.occupancy_check(
+                        "device hash slab", mres["slab_live"], distinct,
+                        level=depth,
+                    )
                 if self.hstore.need_grow(extra=2 * n_new):
                     try:
                         self.hstore.grow()
@@ -3103,10 +3454,11 @@ class JaxChecker:
                 w = max(_pow2(n_new), self.chunk)
                 if self._presize_merge:
                     w = max(w, min(self._presize_merge, new_fps.shape[0]))
+                graft_sanitize.note_dispatch("device.merge")
                 visited = _merge_sorted(visited, new_fps[:w])[
                     : max(_cap4(distinct + 1), self._presize_vcap)
                 ]
-            if pay_host is None:
+            if mres is None and pay_host is None:
                 # level tail boundary: everything after this needs the
                 # trace arrays host-side (window 0 already fetched them
                 # at submit, serially)
@@ -3126,7 +3478,7 @@ class JaxChecker:
                         elapsed=time.monotonic() - t0,
                     )
                 )
-            if graft_sanitize.CURRENT is not None:
+            if graft_sanitize.tracking():
                 # per-level shape signature: a compile in a level whose
                 # signature matches the previous level's is a SILENT
                 # retrace (the regression class the sanitizer exists to
@@ -3144,7 +3496,8 @@ class JaxChecker:
                 sig = (
                     fcap,
                     vshape,
-                    int(new_payload.shape[0]),
+                    mres["cap_out"] if mres is not None
+                    else int(new_payload.shape[0]),
                     self.cap_x, self.cap_g, self.cap_m,
                     getattr(self, "_san_lanes", 0),
                 )
@@ -3182,9 +3535,15 @@ class JaxChecker:
             # --- sampled recomputation audit (BEFORE the level's delta
             # record commits: a caught level never enters the log) -----
             if self.audit and n_new:
+                if mres is not None:
+                    level_fps_ref = mres["fps"]
+                elif fps_host is not None:
+                    level_fps_ref = fps_host
+                else:
+                    level_fps_ref = new_fps
                 problems = self._audit_level(
                     parent_prev, frontier, pidx_np, slot_np,
-                    fps_host if fps_host is not None else new_fps,
+                    level_fps_ref,
                     n_new, depth,
                 )
                 if problems:
@@ -3206,7 +3565,10 @@ class JaxChecker:
                 # level — latent under the sorted store (its per-level
                 # capacity steps declared shape events that excused the
                 # compile), surfaced by the hash slab's constant shape
-                if fps_host is not None:
+                if mres is not None:
+                    # the fused program's one control fetch carried them
+                    fps_np = mres["fps"]
+                elif fps_host is not None:
                     fps_np = fps_host.astype(np.uint64)
                 else:
                     # prefetched through the level-tail window above

@@ -1,0 +1,354 @@
+"""Whole-level megakernel: one jitted device program per BFS level.
+
+docs/PERF.md (Findings 1-2) pins the hot-path cost structure on launch
+count, not FLOPs: every chunk pays ~38 ms of fixed dispatch/queue
+latency on the tunneled backend, and a staged level is still 4-5
+separate device programs (expand span, visited filter, fused dedup,
+materialize slices, invariant scan) plus their control fetches — small
+and mid-size levels run at the launch floor rather than hardware speed.
+PR 6's MXU rewrite removed the structural blocker (the hot kernels are
+gather/scatter-free matmul pipelines), so the whole level fuses into
+ONE program, the stage-fusion move BLEST and "Graph Traversal on
+Tensor Cores" (PAPERS.md) use to keep BFS resident on the accelerator:
+
+1. **chunked expand inside a ``lax.while_loop``** — the trip count is
+   data-bounded (``i * chunk < n_f``) while every shape is static, the
+   repo's fixed-shape idiom, so padded frontier capacity never costs
+   dead chunk expansions; each trip runs the engine's unchanged
+   ``_expand_chunk_impl`` body (MXU guards + compact + materialize +
+   fingerprints) and lands its cap_x compacted candidates in a
+   preallocated lane buffer via ``dynamic_update_slice``;
+2. **fused hashstore probe-and-insert** over the whole level's lanes
+   (ops/hashstore.py ``probe_and_insert_impl`` — uniqueness, visited
+   membership and the store update in one pass, the min-(fp_full,
+   payload) representative per view fingerprint preserved, so counts
+   stay bit-identical to the staged path);
+3. **materialize** of the fresh frontier as a ``lax.scan`` over
+   slice-bounded ``_mat_slice_impl`` bodies (the transient message-set
+   inflate stays slice-sized, exactly the staged path's memory bound);
+4. **invariant/abort scan** folded into the materialize slices, reduced
+   to one first-bad index.
+
+The program returns the new frontier, the pending slab, and a small
+control vector (new-frontier count, abort position, overflow flags,
+first-bad index, slab load) plus the level's trace/delta arrays
+(pidx/slot/fps, pre-cast to their checkpoint dtypes) — the host
+completes ONE ledgered fetch per level (through the pipeline's
+``DeferredFetch``, so the transfer ledger and the ``pipeline.window``
+fault site both still see it) and dispatches the next level.  Every
+overflow class re-enters the engine's existing grow-and-redo machinery
+against the ORIGINAL slab (the kernels are functional; the pending
+slab is discarded), and checkpoint/delta commits, trace
+reconstruction and the ``--audit N`` legacy re-expansion consume the
+fused outputs unchanged.
+
+Buffer donation: on backends that support it (TPU/GPU — the CPU runner
+ignores donation), the frontier argument is donated and returned as a
+pass-through output.  Input-output aliasing makes the pass-through
+zero-copy, which keeps the parent frontier alive for the overflow-redo
+loop and the integrity audit while giving XLA in-place freedom over
+the frontier-shaped intermediates.
+
+The staged path is retained verbatim as the A/B and audit reference:
+``--megakernel 0`` / ``TLA_RAFT_MEGAKERNEL=0`` reverts, and the engine
+falls back per level for the regimes the fused program does not cover
+(orbit's split programs, the external host store beyond the group
+fusion, a degraded hash store, and grouped ultra-deep levels where the
+staged visited pre-filter bounds the candidate working set).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+U64 = jnp.uint64
+I64 = jnp.int64
+I32 = jnp.int32
+# numpy scalars: module import stays device-free (graftlint GL001)
+SENT = np.uint64(0xFFFFFFFFFFFFFFFF)
+BIG = np.int64(1 << 62)
+
+# control-vector layout (i64[CTRL_LEN]); the one fused scalar bundle the
+# host reads per level
+CTRL_N_NEW = 0      # fresh (new-frontier) states this level
+CTRL_ABORT = 1      # first split-brain parent index, BIG if none
+CTRL_OVF_X = 2      # a chunk overflowed its cap_x compaction budget
+CTRL_OVF_SLAB = 3   # a probe window filled (grow + redo vs ORIGINAL slab)
+CTRL_OVF_M = 4      # a child overflowed the cap_m sparse msg-id width
+CTRL_BAD = 5        # first invariant-violating new row, -1 if none
+CTRL_SLAB_LIVE = 6  # live slots of the pending slab (= distinct', free
+#                     conservation signal for integrity.occupancy_check)
+CTRL_LEN = 7
+
+
+def enabled_by_env() -> bool:
+    """Megakernel default: ON; ``TLA_RAFT_MEGAKERNEL=0`` reverts to the
+    staged per-stage program chain (the A/B and audit reference)."""
+    return os.environ.get("TLA_RAFT_MEGAKERNEL", "1") != "0"
+
+
+def donation_supported() -> bool:
+    """Input buffer donation is a no-op (with a log-spam warning) on the
+    CPU runner; only enable it where XLA honors the aliasing."""
+    try:
+        return jax.default_backend() in ("tpu", "gpu", "cuda", "rocm")
+    except Exception:  # graftlint: waive[GL003] — a backend probe
+        # failure just means "no donation"; it must never take the
+        # checker down
+        return False
+
+
+def mat_slice_width(cap_out: int, chunk: int) -> int:
+    """Materialize slice width: the largest chunk multiple <= 8*chunk
+    that tiles ``cap_out`` evenly (capacities are {2^k, 3*2^(k-1)}
+    chunk multiples, so a divisor always exists down to ``chunk``).
+    Mirrors the staged path's 8x-chunk slice bound — the in-program
+    transient (the per-slice message-set inflate) stays slice-sized."""
+    if cap_out <= 8 * chunk:
+        return cap_out
+    for mult in (8, 4, 2, 1):
+        if cap_out % (mult * chunk) == 0:
+            return mult * chunk
+    return chunk
+
+
+# shared jit cache for the fused program: the traced body is fully
+# determined by (kernel identity, chunk, cap_x, cap_m, canon, donation)
+# — the kernel itself is lru-cached per config (ops/successor
+# .get_kernel), so two engines on the same config at the same budgets
+# share ONE jitted program and its compiled executables instead of
+# re-tracing per instance (the test suite builds dozens of same-config
+# checkers; a per-instance cache would pay the fused program's compile
+# each time).  Bounded LRU: a cached program's closure pins its creator
+# engine (and through it the device hash slab), so unbounded growth in
+# a many-config sweep process would be a device-memory leak — eviction
+# caps the pinned set (the service's BucketPrograms cache uses the
+# same bound-the-closure-pins discipline).
+_PROG_CACHE: "dict" = {}
+_PROG_CACHE_MAX = 16
+
+
+def level_program_for(eng, donate: bool):
+    key = (eng.kern, eng.chunk, eng.cap_x, eng.cap_m, eng.canon,
+           bool(donate))
+    entry = _PROG_CACHE.get(key)
+    if entry is not None:
+        prog, owner = entry
+        # staleness guard: the traced body reads the CREATOR's state at
+        # trace time (new shapes trace lazily), so the cached program is
+        # reusable only while the creator still matches the key — a
+        # cap_x/cap_m growth mutates the creator and re-registers it
+        # under its new key, orphaning this entry
+        if (owner.kern is eng.kern and owner.chunk == eng.chunk
+                and owner.cap_x == eng.cap_x
+                and owner.cap_m == eng.cap_m
+                and owner.canon == eng.canon):
+            # LRU touch
+            _PROG_CACHE.pop(key)
+            _PROG_CACHE[key] = (prog, owner)
+            return prog
+    prog = build_level_program(eng, donate)
+    _PROG_CACHE[key] = (prog, eng)
+    while len(_PROG_CACHE) > _PROG_CACHE_MAX:
+        _PROG_CACHE.pop(next(iter(_PROG_CACHE)))
+    return prog
+
+
+def build_level_program(eng, donate: bool):
+    """The jitted whole-level program for one engine configuration.
+
+    Closes over the engine's chunk/cap_x/cap_m/canon/kernel state, so
+    the engine rebuilds it whenever any of those change (the same
+    re-jit discipline as ``_jit_expand_programs``).  ``cap_out`` — the
+    new frontier's static capacity — is a static argument: the shape
+    ladder quantizes it through ``_frontier_cap`` and the AOT prewarmer
+    compiles the forecast rungs ahead of depth.
+
+    Returns outputs
+      ``(new_frontier, slab2, ctrl i64[CTRL_LEN], mult i64[K],
+         fps u64[cap_out], pidx u32[cap_out], slot u16|u32[cap_out]
+         [, frontier_passthrough])``
+    with the pass-through present only under donation (input-output
+    aliasing makes it zero-copy; it keeps the parent frontier alive for
+    redo and audit).
+    """
+    from ..ops import hashstore
+
+    chunk = eng.chunk
+    cap_x = eng.cap_x
+    K = eng.K
+    slot_dt = jnp.uint16 if K <= 0xFFFF else jnp.uint32
+
+    def level_body(frontier, slab, n_f, cap_out: int):
+        # trace-time staleness tripwire: the body calls the creator
+        # engine's methods, which read its LIVE cap_x/chunk — if the
+        # creator's budgets drifted from this build's snapshot, a lazy
+        # re-trace would write wrong-width chunk outputs at the old
+        # stride (silent candidate corruption).  Callers re-resolve
+        # through level_program_for per level, so this can only fire on
+        # a plumbing regression — loudly, not silently.
+        if eng.cap_x != cap_x or eng.chunk != chunk:
+            raise RuntimeError(
+                "megakernel program stale: creator engine's budgets "
+                f"changed (cap_x {cap_x}->{eng.cap_x}, chunk "
+                f"{chunk}->{eng.chunk}); re-fetch via level_program_for"
+            )
+        cap_f = frontier.voted_for.shape[0]
+        n_chunks = cap_f // chunk
+        N = n_chunks * cap_x  # level-wide candidate lane budget
+
+        # -- 1. chunked expand: while_loop with a data-bounded trip
+        # count over static shapes — dead chunks beyond n_f never run
+        def cond(c):
+            i = c[0]
+            return i.astype(I64) * chunk < n_f
+
+        def body(c):
+            i, cv_b, cf_b, cp_b, mult, ab, ovf = c
+            start = i.astype(I64) * chunk
+            part = jax.tree.map(
+                lambda x: jax.lax.dynamic_slice_in_dim(
+                    x, i * chunk, chunk
+                ),
+                frontier,
+            )
+            cv, cf, cp, m, a, o = eng._expand_chunk_impl(part, start, n_f)
+            off = i * cap_x
+            cv_b = jax.lax.dynamic_update_slice(cv_b, cv, (off,))
+            cf_b = jax.lax.dynamic_update_slice(cf_b, cf, (off,))
+            cp_b = jax.lax.dynamic_update_slice(cp_b, cp, (off,))
+            return (
+                i + 1, cv_b, cf_b, cp_b,
+                mult + m, jnp.minimum(ab, a), ovf | o,
+            )
+
+        init = (
+            jnp.zeros((), I32),
+            jnp.full((N,), SENT, U64),
+            jnp.full((N,), SENT, U64),
+            jnp.full((N,), -1, I64),
+            jnp.zeros((K,), I64),
+            jnp.asarray(BIG, I64),
+            jnp.zeros((), bool),
+        )
+        (_i, cv_buf, cf_buf, cp_buf, mult, abort_at,
+         ovf_x) = jax.lax.while_loop(cond, body, init)
+
+        # -- 2. fused probe-and-insert: uniqueness + membership + store
+        # update in one pass; fresh lanes compact to a prefix in LANE
+        # (= payload-ascending) order, the staged path's exact contract
+        slab2, fresh, n_new, ovf_slab = hashstore.probe_and_insert_impl(
+            slab, cv_buf, cf_buf, cp_buf
+        )
+        new_fps, new_pay = hashstore.compact_fresh(fresh, cv_buf, cp_buf, N)
+        if cap_out > N:
+            # tiny cap_x configs: the frontier-capacity quantizer's
+            # >= chunk floor can exceed the lane budget — pad with dead
+            # lanes (n_new <= N always, so nothing real is cut)
+            new_fps = jnp.concatenate(
+                [new_fps, jnp.full((cap_out - N,), SENT, U64)]
+            )
+            new_pay = jnp.concatenate(
+                [new_pay, jnp.full((cap_out - N,), -1, I64)]
+            )
+        fps_out = new_fps[:cap_out]
+        pay_out = new_pay[:cap_out]
+
+        # -- 3+4. materialize + invariant scan over slice-bounded scan
+        # steps.  cap_out is a forecast (it overshoots n_new by design,
+        # that is what makes the shape static), so slices wholly beyond
+        # n_new are SKIPPED via lax.cond — the scan body is sequential,
+        # the dead branch emits zeros (exactly the staged path's
+        # zero-padded frontier tail) and the overshoot costs nothing
+        sl = mat_slice_width(cap_out, chunk)
+        n_slices = cap_out // sl
+
+        def live_slice(args):
+            pay_slice, take = args
+            return eng._mat_slice_impl(frontier, pay_slice, take)
+
+        def dead_slice(args):
+            pay_slice, _take = args
+            child = jax.tree.map(
+                lambda x: jnp.zeros(
+                    (sl,) + x.shape[1:], x.dtype
+                ),
+                frontier,
+            )
+            return child, jnp.asarray(-1, I64), jnp.zeros((), bool)
+
+        def mat_body(_carry, si):
+            pay_slice = jax.lax.dynamic_slice_in_dim(pay_out, si * sl, sl)
+            take = jnp.clip(n_new - si.astype(I64) * sl, 0, sl)
+            child, bad_at, ovf_m = jax.lax.cond(
+                take > 0, live_slice, dead_slice, (pay_slice, take)
+            )
+            return _carry, (child, bad_at, ovf_m)
+
+        _c, (children, bad_ats, ovf_ms) = jax.lax.scan(
+            mat_body, jnp.zeros((), I32), jnp.arange(n_slices, dtype=I32)
+        )
+        new_frontier = jax.tree.map(
+            lambda x: x.reshape((cap_out,) + x.shape[2:]), children
+        )
+        # first bad global index: slices stack in order, so the minimum
+        # of (si*sl + first_bad_in_slice) IS the first bad overall
+        sli = jnp.arange(n_slices, dtype=I64)
+        badg = jnp.where(bad_ats >= 0, sli * sl + bad_ats, BIG)
+        bad_min = badg.min()
+        bad_global = jnp.where(bad_min >= BIG, jnp.asarray(-1, I64), bad_min)
+
+        ctrl = jnp.stack([
+            n_new.astype(I64),
+            abort_at,
+            ovf_x.astype(I64),
+            ovf_slab.astype(I64),
+            ovf_ms.any().astype(I64),
+            bad_global,
+            (slab2 != SENT).sum().astype(I64),
+        ])
+        pidx_out = (pay_out // K).astype(jnp.uint32)
+        slot_out = (pay_out % K).astype(slot_dt)
+        outs = (new_frontier, slab2, ctrl, mult, fps_out, pidx_out,
+                slot_out)
+        if donate:
+            # pass-through keeps the donated parent alive for the
+            # overflow-redo loop and the audit (aliased, zero-copy)
+            outs = outs + (frontier,)
+        return outs
+
+    return jax.jit(
+        level_body,
+        static_argnames=("cap_out",),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def ledger_trace(cfg=None):
+    """Closed jaxpr of the megakernel at the audit's tiny reference
+    shapes — the graftlint layer-2 registration (golden ledger + the
+    GL010 gather/scatter budget: the MXU expand/materialize inside
+    contribute 0 data-indexed gathers; the ledgered residue is the
+    hashstore probe rounds and the materialize parent-row gathers)."""
+    from ..config import RaftConfig
+    from ..models.raft import init_batch
+    from ..ops import hashstore
+    from .bfs import JaxChecker
+
+    if cfg is None:
+        cfg = RaftConfig(
+            n_servers=2, n_vals=1, max_election=1, max_restart=1,
+        )
+    eng = JaxChecker(cfg, chunk=64, use_hashstore=True, megakernel=True)
+    fr0, _ovf = eng._deflate(init_batch(cfg, 1))
+    fr = eng._frontier_struct(fr0, 64)
+    slab = jax.ShapeDtypeStruct((hashstore.MIN_CAP,), jnp.uint64)
+    n_f = jax.ShapeDtypeStruct((), jnp.int64)
+    prog = build_level_program(eng, donate=False)
+    return jax.make_jaxpr(
+        lambda f, s, n: prog(f, s, n, cap_out=64)
+    )(fr, slab, n_f)

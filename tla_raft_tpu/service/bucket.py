@@ -63,8 +63,10 @@ import jax
 import jax.numpy as jnp
 
 from .. import resilience
+from ..analysis import sanitize as graft_sanitize
 from ..config import RaftConfig
 from ..engine import forecast
+from ..engine import megakernel as graft_megakernel
 from ..engine.invariants import resolve_invariant_kernel
 from ..models.raft import RaftState, init_batch
 from ..ops import hashstore
@@ -137,6 +139,13 @@ class BucketPrograms:
         ]
         self.step = jax.jit(self._level_step)
         self.mat = jax.jit(self._mat_step)
+        # whole-level fusion (the service slice of the megakernel,
+        # engine/megakernel.py): step + on-device survivor-lane
+        # compaction + materialize + invariant scan as ONE program —
+        # a bucket level becomes one dispatch + one fused fetch
+        self.fused = jax.jit(
+            self._fused_level, static_argnames=("g_cap",)
+        )
         self.inv_ok = jax.jit(self._inv_ok)
         # shape keys seen by the jitted entry points — the honest
         # "programs traced" ledger behind the bench's
@@ -212,6 +221,40 @@ class BucketPrograms:
         bad = (~self._inv_ok(children)) & in_range
         return children, bad
 
+    def _fused_level(self, st, live, crow, mr_row, salt_row, slab,
+                     done_c, g_cap: int):
+        """One whole bucket level as ONE device program: the step body,
+        the survivor-lane compaction the host used to do with
+        ``np.nonzero`` (cumsum + trash-slot scatter, lane order
+        preserved — identical to the host's ascending-lane selection),
+        the materialize and the invariant scan.  ``done_c`` carries the
+        pre-level retirement flags so lanes of configs that abort THIS
+        level (or were already done) are dropped exactly as the host
+        filter dropped them; padding lanes resolve to (row 0, slot 0)
+        like the host's zero-filled ``rows_p``/``slots_p``, keeping the
+        padded children bit-identical between the paths.  On
+        ``ovf_g`` (more survivors than ``g_cap``) the host redoes with
+        the exact capacity from the control fetch."""
+        (slab2, fresh, fps, gen_c, new_c, abort_c,
+         ovf) = self._level_step(st, live, crow, mr_row, salt_row, slab)
+        K = self.K
+        B = live.shape[0]
+        lane_cfg = jnp.repeat(crow, K)
+        keep = fresh & ~(done_c[lane_cfg] | abort_c[lane_cfg])
+        n_g = keep.sum().astype(I64)
+        dest = jnp.cumsum(keep) - 1
+        tgt = jnp.where(keep, dest, g_cap)
+        lanes_pad = jnp.zeros((g_cap,), I64).at[tgt].set(
+            jnp.arange(B * K, dtype=I64), mode="drop"
+        )
+        rows = lanes_pad // K
+        slots = lanes_pad % K
+        children, bad = self._mat_step(
+            st, rows, slots, jnp.minimum(n_g, g_cap)
+        )
+        return (slab2, children, bad, rows, fresh, fps, gen_c, new_c,
+                abort_c, ovf, n_g > g_cap, n_g)
+
     # -- cold-path helpers -------------------------------------------------
 
     def bad_invariant_name(self, children: RaftState, idx: int) -> str:
@@ -255,6 +298,7 @@ class BatchedChecker:
         cfgs: list[RaftConfig],
         max_depths: list[int | None] | None = None,
         use_mxu: bool | None = None,
+        megakernel: bool | None = None,
         progress=None,
     ):
         if not cfgs:
@@ -272,6 +316,11 @@ class BatchedChecker:
         )
         if use_mxu is None:
             use_mxu = mxu_enabled_by_env()
+        # fused bucket levels (one program + one fetch per level) ride
+        # the same lever as the engine megakernel: TLA_RAFT_MEGAKERNEL
+        if megakernel is None:
+            megakernel = graft_megakernel.enabled_by_env()
+        self.megakernel = bool(megakernel)
         self.C_pad = max(2, forecast.pow2ceil(self.C))
         self.progs = _get_programs(self.kcfg, bool(use_mxu), self.C_pad)
         self.kern = self.progs.kern
@@ -529,6 +578,8 @@ class BatchedChecker:
         ]
         g_floor = 8  # frontier-capacity ratchet (grows only: one
         # program per magnitude, never a shrink retrace)
+        last_n_g = 8  # previous level's survivor count: the fused
+        # path's pre-dispatch g_cap signal before the forecast warms
 
         # ---- level loop --------------------------------------------------
         while True:
@@ -551,27 +602,88 @@ class BatchedChecker:
             B = int(live_h.shape[0])
             live = jnp.asarray(live_h)
             crow = jnp.asarray(crow_h)
-            while True:  # slab-overflow redo loop (engine-shaped)
-                progs.note_shapes("step", B, int(slab.shape[0]))
-                out = progs.step(st, live, crow, mr_dev, salt_dev, slab)
-                (slab2, fresh_d, fps_d, gen_d, new_d, abort_d,
-                 ovf_d) = out
-                fresh_h, fps_h, gen_c, new_c, abort_c, ovf = (
-                    jax.device_get(
-                        (fresh_d, fps_d, gen_d, new_d, abort_d, ovf_d)
-                    )
+            children = bad_h = rows_h = n_g_dev = None
+            if self.megakernel:
+                # ---- fused bucket level: ONE program + ONE fetch ----
+                # g_cap (the survivor-lane capacity) must be static
+                # BEFORE the dispatch: ratchet floor + forecast, with
+                # the exact count from the control fetch driving the
+                # rare redo (the engine megakernel's cap_out shape)
+                done_pad = np.concatenate(
+                    [done, np.ones(C_pad - C, bool)]
                 )
-                self.stats["dispatches"] += 1
-                if not bool(ovf):
+                g_cap = max(g_floor, forecast.pow2ceil(last_n_g))
+                if len(level_totals) > forecast.MIN_LEVELS:
+                    peak = forecast.forecast_peak_new(level_totals, None)
+                    peak = min(
+                        max(peak, 1), 4 * max(last_n_g, 8), 1 << 20
+                    )
+                    g_cap = max(g_cap, forecast.pow2ceil(peak))
+                while True:  # slab / g_cap redo loop (engine-shaped)
+                    progs.note_shapes(
+                        "fused", B, int(slab.shape[0]), g_cap
+                    )
+                    (slab2, children, bad_d, rows_d, fresh_d, fps_d,
+                     gen_d, new_d, abort_d, ovf_d, ovfg_d,
+                     n_g_dev) = progs.fused(
+                        st, live, crow, mr_dev, salt_dev, slab,
+                        jnp.asarray(done_pad), g_cap=g_cap,
+                    )
+                    (fresh_h, fps_h, gen_c, new_c, abort_c, ovf, ovf_g,
+                     n_g_fused, bad_h, rows_h) = jax.device_get((
+                        fresh_d, fps_d, gen_d, new_d, abort_d, ovf_d,
+                        ovfg_d, n_g_dev, bad_d, rows_d,
+                    ))
+                    self.stats["dispatches"] += 1
+                    graft_sanitize.note_dispatch("service.fused")
+                    if bool(ovf):
+                        # probe-window overflow: rebuild a bigger slab
+                        # from the inserted-fps ledger and redo (the
+                        # pending slab2 is discarded — functional)
+                        self.stats["redos"] += 1
+                        slab, _cap = self._rebuild_slab(
+                            all_fps, 2 * int(slab.shape[0])
+                        )
+                        continue
+                    if bool(ovf_g):
+                        # exact survivor count is in the control fetch:
+                        # one redo lands the capacity
+                        self.stats["redos"] += 1
+                        g_cap = max(
+                            2 * g_cap, forecast.pow2ceil(int(n_g_fused))
+                        )
+                        continue
                     slab = slab2
                     break
-                # probe-window overflow: rebuild a bigger slab from the
-                # inserted-fps ledger and redo the level (the pending
-                # slab2 is discarded — kernels are functional)
-                self.stats["redos"] += 1
-                slab, _cap = self._rebuild_slab(
-                    all_fps, 2 * int(slab.shape[0])
-                )
+                G_cap = g_floor = g_cap
+                bad_h = np.asarray(bad_h)
+                rows_h = np.asarray(rows_h, np.int64)
+            else:
+                while True:  # slab-overflow redo loop (engine-shaped)
+                    progs.note_shapes("step", B, int(slab.shape[0]))
+                    out = progs.step(
+                        st, live, crow, mr_dev, salt_dev, slab
+                    )
+                    (slab2, fresh_d, fps_d, gen_d, new_d, abort_d,
+                     ovf_d) = out
+                    fresh_h, fps_h, gen_c, new_c, abort_c, ovf = (
+                        jax.device_get(
+                            (fresh_d, fps_d, gen_d, new_d, abort_d, ovf_d)
+                        )
+                    )
+                    self.stats["dispatches"] += 1
+                    graft_sanitize.note_dispatch("service.step")
+                    if not bool(ovf):
+                        slab = slab2
+                        break
+                    # probe-window overflow: rebuild a bigger slab from
+                    # the inserted-fps ledger and redo the level (the
+                    # pending slab2 is discarded — kernels are
+                    # functional)
+                    self.stats["redos"] += 1
+                    slab, _cap = self._rebuild_slab(
+                        all_fps, 2 * int(slab.shape[0])
+                    )
             self.stats["levels"] += 1
 
             # abort (in-kernel Assert) fires BEFORE the level is
@@ -587,11 +699,12 @@ class BatchedChecker:
                 if not done[c]:
                     gen[c] += int(gen_c[c])
 
-            lanes = np.nonzero(fresh_h)[0]
-            lane_cfg = crow_h[lanes // K]
-            keep = ~done[lane_cfg]
-            lanes = lanes[keep]
-            lane_cfg = lane_cfg[keep]
+            if not self.megakernel:
+                lanes = np.nonzero(fresh_h)[0]
+                lane_cfg = crow_h[lanes // K]
+                keep = ~done[lane_cfg]
+                lanes = lanes[keep]
+                lane_cfg = lane_cfg[keep]
             if len(fps_h):
                 # ledger of every inserted fp (slab rebuild source) —
                 # includes retired members' lanes already in the slab
@@ -609,8 +722,16 @@ class BatchedChecker:
                     level_sizes[c].append(n_new)
                     depth[c] += 1
 
-            lanes = lanes[~done[lane_cfg]]
-            n_g = len(lanes)
+            if self.megakernel:
+                # survivor selection already ran on device (identical
+                # keep-mask semantics: fresh & ~done & ~abort, lane
+                # order ascending); rows beyond n_g are 0-filled like
+                # the staged ``rows_p``
+                n_g = int(n_g_fused)
+                rows = rows_h[:n_g]
+            else:
+                lanes = lanes[~done[lane_cfg]]
+                n_g = len(lanes)
             if n_g == 0:
                 for c in range(C):
                     if not done[c]:
@@ -618,31 +739,35 @@ class BatchedChecker:
                 break
 
             level_totals.append(int(sum(int(x) for x in new_c[:C])))
-            rows = (lanes // K).astype(np.int64)
-            slots = (lanes % K).astype(np.int64)
+            if not self.megakernel:
+                rows = (lanes // K).astype(np.int64)
+                slots = (lanes % K).astype(np.int64)
             crow_next = crow_h[rows]
-            G_cap = max(g_floor, forecast.pow2ceil(n_g))
-            if len(level_totals) > forecast.MIN_LEVELS:
-                # presize ONE magnitude ahead when the forecast says
-                # growth continues: saves the next pow2 retrace without
-                # inflating the padded per-level compute (a wide cap
-                # was measured 3x slower on CPU — dead padded lanes
-                # are not free)
-                peak = forecast.forecast_peak_new(level_totals, None)
-                peak = min(max(peak, n_g), 2 * max(n_g, 1), 1 << 20)
-                G_cap = max(G_cap, forecast.pow2ceil(peak))
-            g_floor = G_cap
-            rows_p = np.zeros(G_cap, np.int64)
-            rows_p[:n_g] = rows
-            slots_p = np.zeros(G_cap, np.int64)
-            slots_p[:n_g] = slots
-            progs.note_shapes("mat", B, G_cap)
-            children, bad_d = progs.mat(
-                st, jnp.asarray(rows_p), jnp.asarray(slots_p),
-                jnp.asarray(n_g, I64),
-            )
-            bad_h = np.asarray(jax.device_get(bad_d))
-            self.stats["dispatches"] += 1
+            if not self.megakernel:
+                G_cap = max(g_floor, forecast.pow2ceil(n_g))
+                if len(level_totals) > forecast.MIN_LEVELS:
+                    # presize ONE magnitude ahead when the forecast says
+                    # growth continues: saves the next pow2 retrace
+                    # without inflating the padded per-level compute (a
+                    # wide cap was measured 3x slower on CPU — dead
+                    # padded lanes are not free)
+                    peak = forecast.forecast_peak_new(level_totals, None)
+                    peak = min(max(peak, n_g), 2 * max(n_g, 1), 1 << 20)
+                    G_cap = max(G_cap, forecast.pow2ceil(peak))
+                g_floor = G_cap
+                rows_p = np.zeros(G_cap, np.int64)
+                rows_p[:n_g] = rows
+                slots_p = np.zeros(G_cap, np.int64)
+                slots_p[:n_g] = slots
+                progs.note_shapes("mat", B, G_cap)
+                children, bad_d = progs.mat(
+                    st, jnp.asarray(rows_p), jnp.asarray(slots_p),
+                    jnp.asarray(n_g, I64),
+                )
+                bad_h = np.asarray(jax.device_get(bad_d))
+                self.stats["dispatches"] += 1
+                graft_sanitize.note_dispatch("service.mat")
+            last_n_g = n_g
             lvl += 1
 
             if self.progress is not None:
