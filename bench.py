@@ -39,7 +39,9 @@ sort-path A/B), BENCH_PIPELINE (0 = serial-chain A/B) /
 BENCH_PIPELINE_WINDOW (in-flight fetch groups, default 2), BENCH_MXU
 (0 = legacy per-lane expand A/B), BENCH_MEGAKERNEL (0 = staged
 program-chain A/B vs the fused whole-level program; dispatches/level
-land in the record either way), BENCH_AUDIT (1 = integrity audit at
+land in the record either way), BENCH_SUPERSTEP (0 = per-level fused
+A/B vs the multi-level resident superstep driver; levels_per_dispatch
+lands in the record either way), BENCH_AUDIT (1 = integrity audit at
 BENCH_AUDIT_N rows/level, default 64 — overhead A/B, single-device
 arm), BENCH_SERVICE (1 = the sweep-service
 jobs/hour A/B on the synthetic queue instead — see _bench_service).
@@ -191,17 +193,22 @@ def _best_window_rate(levels, fallback, max_level=None):
     Excludes the cold-compile ramp.  ``max_level`` restricts the search to
     a depth prefix so the rate covers the same level mix as a depth-capped
     baseline run (ADVICE r3: steady-vs-overall across different depths is
-    not comparable)."""
+    not comparable).  The window must also span >= 2% of the run's wall
+    time: with multi-level supersteps every level of one dispatch window
+    reports the SAME elapsed timestamp, so a window inside one burst
+    divides a real state count by measurement noise (the first superstep
+    A/B "measured" 10^8 states/s that way)."""
     lv = [x for x in levels if max_level is None or x[0] <= max_level]
     best = fallback
     if not lv:
         return best
     total = lv[-1][1]
+    wall = lv[-1][2]
     for i in range(len(lv)):
         for j in range(i + 2, len(lv)):
             dn = lv[j][1] - lv[i][1]
             dtm = lv[j][2] - lv[i][2]
-            if dn >= total // 4 and dtm > 0:
+            if dn >= total // 4 and dtm > max(0.02 * wall, 1e-9):
                 best = max(best, dn / dtm)
     return best
 
@@ -230,6 +237,15 @@ def _bench_service_arm(jax) -> int:
     chunk = int(os.environ.get("BENCH_SERVICE_CHUNK", "64"))
     jobs = queue_synth.synth_jobs(n_jobs, seed, mr_width, chunk)
     root = os.path.join(os.environ["BENCH_SERVICE_BASE"], arm)
+    if int(os.environ.get("BENCH_SERVICE_WARM", "0")):
+        # steady-state mode: drain one priming copy of the queue first
+        # so the timed drain measures the long-lived daemon's warm
+        # regime (program ladder + persistent compile cache paid) —
+        # the default cold mode keeps measuring the ladder cost itself
+        qw = JobQueue(root + "_warmup")
+        for cfg, cap, opt in jobs:
+            qw.submit(cfg, max_depth=cap, options=opt)
+        Scheduler(qw, batch=(arm == "batched")).run_once()
     q = JobQueue(root)
     jids = [
         q.submit(cfg, max_depth=cap, options=opt)
@@ -260,7 +276,9 @@ def _bench_service(jax) -> int:
     base key, so every bucket demonstrates >= 10 configs on one
     compiled program ladder), BENCH_SERVICE_MR_WIDTH,
     BENCH_SERVICE_SEED, BENCH_SERVICE_CHUNK, BENCH_SERVICE_ROOT (keep
-    the queue dirs)."""
+    the queue dirs), BENCH_SERVICE_WARM (1 = time a second drain after
+    a priming pass — the long-lived daemon's steady state; default 0
+    keeps measuring the cold compile-ladder cost)."""
     import shutil
     import subprocess
     import tempfile
@@ -344,7 +362,9 @@ def _bench_service(jax) -> int:
             f"{os.environ.get('BENCH_SERVICE_SEED', '1')}, mr_width "
             f"{os.environ.get('BENCH_SERVICE_MR_WIDTH', '16')}, chunk "
             f"{os.environ.get('BENCH_SERVICE_CHUNK', '64')}, "
-            "cold per-arm compile caches)"
+            + ("warm steady state: per-arm queue primed once)"
+               if int(os.environ.get("BENCH_SERVICE_WARM", "0"))
+               else "cold per-arm compile caches)")
         ),
     }
     if mismatch is not None:
@@ -568,6 +588,16 @@ def main():
         # "Whole-level megakernel"); counts are bit-identical either
         # way, so the parity gates hold in both arms
         use_mega = bool(int(os.environ.get("BENCH_MEGAKERNEL", "1")))
+        # BENCH_SUPERSTEP=0 pins the per-level fused path (span 1) —
+        # the A/B lever for the multi-level resident supersteps
+        # (docs/PERF.md "Multi-level supersteps"); 1/unset keeps the
+        # engine default span (TLA_RAFT_SUPERSTEP, 4).  Counts are
+        # bit-identical either way, so the parity gates hold in both
+        # arms.
+        ss_env = os.environ.get("BENCH_SUPERSTEP")
+        use_superstep = (
+            None if ss_env is None or int(ss_env) else 1
+        )
         # BENCH_AUDIT=1 arms the end-to-end integrity audit at
         # BENCH_AUDIT_N rows/level (default 64) — the A/B lever for the
         # audit-mode overhead record (docs/ROBUSTNESS.md; target < 5%
@@ -623,6 +653,7 @@ def main():
                     use_hashstore=use_hs,
                     pipeline=use_pipe, pipeline_window=pipe_window,
                     use_mxu=use_mxu, megakernel=use_mega, audit=audit_n,
+                    superstep=use_superstep,
                 )
                 res = chk1.run(max_depth=max_depth)
             finally:
@@ -737,6 +768,11 @@ def main():
             bool(getattr(chk1, "megakernel", False)) if not mesh_n
             else False
         ),
+        # the EFFECTIVE superstep span (1 = per-level; the lever is
+        # BENCH_SUPERSTEP=0/1, the span itself TLA_RAFT_SUPERSTEP)
+        "superstep": (
+            int(getattr(chk1, "superstep_span", 1)) if not mesh_n else 1
+        ),
         "audit": audit_n if not mesh_n else 0,
     }
     if not mesh_n:
@@ -749,6 +785,12 @@ def main():
         ]
         out["dispatches_per_level"] = list(dlog.per_level)
         out["steady_max_dispatches_per_level"] = dlog.steady_max()
+        # dispatch amortization: BFS levels retired per engine program
+        # dispatch (the superstep's headline metric — 1/span in steady
+        # state, 1.0 on the per-level paths modulo redos)
+        out["levels_per_dispatch"] = round(
+            len(dlog.per_level) / max(dlog.total, 1), 3
+        )
     if full_golden is not None:
         out["golden_full"] = {
             "distinct": full_golden[0],
@@ -797,11 +839,13 @@ def main():
             "pipeline_window": out["pipeline_window"],
             "mxu": out["mxu"],
             "megakernel": out["megakernel"],
+            "superstep": out["superstep"],
             "audit": out["audit"],
         }
         for k in ("mesh", "mesh_deep", "peak_dev_rows", "exchange",
                   "level_seconds", "dispatches_per_level",
-                  "steady_max_dispatches_per_level"):
+                  "steady_max_dispatches_per_level",
+                  "levels_per_dispatch"):
             if k in out:
                 record[k] = out[k]
         tmp = bench_out + ".tmp"

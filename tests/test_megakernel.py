@@ -38,8 +38,12 @@ def _quad(res):
 # -- fused-vs-staged bit-identical parity ---------------------------------
 
 def test_fused_vs_staged_s2_fixpoint():
+    # superstep=1 pins the PER-LEVEL fused path (the multi-level
+    # driver is default-on and has its own suite, tests/test_superstep
+    # .py) — this row asserts every level ran through the per-level
+    # megakernel
     a = JaxChecker(S2, chunk=64, megakernel=False).run()
-    chk = JaxChecker(S2, chunk=64, megakernel=True)
+    chk = JaxChecker(S2, chunk=64, megakernel=True, superstep=1)
     b = chk.run()
     assert _quad(a) == _quad(b)
     assert a.action_counts == b.action_counts
@@ -81,7 +85,7 @@ def test_slab_overflow_grows_and_redoes(monkeypatch):
     monkeypatch.setattr(
         DeviceHashStore, "need_grow", lambda self, extra=0: False
     )
-    chk = JaxChecker(S2, chunk=64, megakernel=True)
+    chk = JaxChecker(S2, chunk=64, megakernel=True, superstep=1)
     res = chk.run()
     assert (res.distinct, res.depth) == (50, 12)
     assert chk._mega_stats["redo_slab"] > 0
@@ -100,14 +104,15 @@ def test_cap_out_overflow_exact_redo(monkeypatch):
     monkeypatch.setattr(JaxChecker, "_mega_cap_out", tiny_guess)
     # chunk=2: the minimum rung (the 4*chunk one-shape floor) is 8,
     # under the S2 peak level of 9 — the forced guess must overflow
-    chk = JaxChecker(S2, chunk=2, megakernel=True)
+    chk = JaxChecker(S2, chunk=2, megakernel=True, superstep=1)
     res = chk.run()
     assert (res.distinct, res.depth) == (50, 12)
     assert chk._mega_stats["redo_out"] > 0
 
 
 def test_cap_x_overflow_grows_and_redoes():
-    chk = JaxChecker(S2, chunk=64, cap_x=16, megakernel=True)
+    chk = JaxChecker(S2, chunk=64, cap_x=16, megakernel=True,
+                     superstep=1)
     res = chk.run()
     assert (res.distinct, res.depth) == (50, 12)
     assert chk._mega_stats["redo_x"] > 0
@@ -118,7 +123,8 @@ def test_cap_m_overflow_grows_and_redoes():
     # the staged reference is the pinned S3V1 fixpoint (545 distinct,
     # gated bit-identically by test_fused_vs_staged_s3v1_fixpoint) —
     # one fused run keeps this overflow row cheap in the fast tier
-    chk = JaxChecker(S3V1, chunk=256, cap_m=4, megakernel=True)
+    chk = JaxChecker(S3V1, chunk=256, cap_m=4, megakernel=True,
+                     superstep=1)
     res = chk.run()
     assert (res.distinct, res.depth) == (545, 19)
     assert chk._mega_stats["redo_m"] > 0
@@ -138,7 +144,8 @@ def test_grow_failure_degrades_to_staged():
                  DeviceHashStore, "need_grow",
                  lambda self, extra=0: False,
              ):
-            chk = JaxChecker(S2, chunk=64, megakernel=True)
+            chk = JaxChecker(S2, chunk=64, megakernel=True,
+                             superstep=1)
             res = chk.run()
     finally:
         faults.install("")
@@ -189,7 +196,7 @@ def test_bucket_fused_vs_staged_parity():
         for mr in (0, 1, 2)
     ]
     a = BatchedChecker(cfgs, megakernel=False).run()
-    chk = BatchedChecker(cfgs, megakernel=True)
+    chk = BatchedChecker(cfgs, megakernel=True, superstep=1)
     b = chk.run()
     keys = ("ok", "distinct", "generated", "depth", "level_sizes",
             "violation")
@@ -251,6 +258,7 @@ def test_level_start_kill_recover_fused(tmp_path):
     ck = str(tmp_path / "ck")
     common = [
         "--config", str(cfg), "--chunk", "64", "--megakernel", "1",
+        "--superstep", "1",
         "--checkpoint-dir", ck, "--log", "-", "--json",
     ]
     killed = _run_cli(common, fault="level.start:kill@4")
@@ -275,6 +283,9 @@ def test_sanitize_smoke_one_dispatch_one_fetch(tmp_path):
     env.update(
         GRAFT_SANITIZE="1", JAX_PLATFORMS="cpu",
         TLA_RAFT_MEGAKERNEL="1",
+        # pin the PER-LEVEL fused path: supersteps are default-on and
+        # would otherwise run engine/superstep.py under this gate
+        TLA_RAFT_SUPERSTEP="1",
         PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
     )
     proc = subprocess.run(
@@ -304,7 +315,9 @@ def test_dispatch_log_counts_fused_levels():
     log = DispatchLog()
     set_dispatch_sink(log)
     try:
-        res = JaxChecker(S2, chunk=64, megakernel=True).run()
+        res = JaxChecker(
+            S2, chunk=64, megakernel=True, superstep=1
+        ).run()
     finally:
         set_dispatch_sink(None)
     log.close()

@@ -81,7 +81,10 @@ def main(argv=None) -> int:
             "wrote dispatch budgets "
             f"(fused {dledger['fused']['max_dispatches_per_level']}, "
             f"staged {dledger['staged']['max_dispatches_per_level']} "
-            "programs/level) to "
+            "programs/level; superstep "
+            f"{dledger['superstep']['total_dispatches']} programs over "
+            f"{dledger['superstep']['levels']} levels at span "
+            f"{dledger['superstep']['span']}) to "
             f"{dispatch_audit.DISPATCH_LEDGER_PATH}"
         )
         return 0

@@ -40,6 +40,9 @@ DISPATCH_LEDGER_PATH = os.path.join(
 # the steady-state tail
 WARMUP_LEVELS = 2
 
+# the span the superstep arm measures at (the engine default)
+SUPERSTEP_SPAN = 4
+
 
 def _tiny_cfg():
     from ..config import RaftConfig
@@ -52,8 +55,13 @@ def _tiny_cfg():
     )
 
 
-def measure(megakernel: bool) -> dict:
-    """One measured run -> the per-level dispatch profile."""
+def measure(megakernel: bool, superstep: int = 1) -> dict:
+    """One measured run -> the per-level dispatch profile.
+
+    ``superstep`` pins the multi-level span: the fused/staged arms
+    measure the PER-LEVEL paths (span 1) regardless of the ambient
+    TLA_RAFT_SUPERSTEP, and the superstep arm measures the resident
+    driver at its declared span."""
     from ..engine import JaxChecker
     from .sanitize import DispatchLog, set_dispatch_sink
 
@@ -68,20 +76,31 @@ def measure(megakernel: bool) -> dict:
     try:
         res = JaxChecker(
             _tiny_cfg(), chunk=64, megakernel=megakernel,
-            use_hashstore=True,
+            use_hashstore=True, superstep=superstep,
         ).run()
     finally:
         set_dispatch_sink(None)
         if orb is not None:
             os.environ["TLA_RAFT_ORBIT"] = orb
     log.close()
-    return dict(
+    out = dict(
         max_dispatches_per_level=log.steady_max(WARMUP_LEVELS),
         levels=len(log.per_level),
         total_dispatches=log.total,
         distinct=res.distinct,
         depth=res.depth,
     )
+    if superstep > 1:
+        # the superstep budgets: worst dispatches per superstep window
+        # (the 1-dispatch claim) and the total-dispatch count for the
+        # whole run (the amortized <= 1/N-per-level claim — levels and
+        # stops are deterministic on the tiny config, so the total is
+        # an exact pin, not a tolerance)
+        out["span"] = superstep
+        out["supersteps"] = len(log.per_superstep)
+        out["superstep_levels"] = int(sum(log.superstep_levels))
+        out["max_dispatches_per_superstep"] = log.steady_max_superstep()
+    return out
 
 
 def build_ledger() -> dict:
@@ -93,10 +112,13 @@ def build_ledger() -> dict:
             "config": "S2V1E1R1",
             "warmup_levels": WARMUP_LEVELS,
             "metric": "worst post-warmup dispatches/level "
-                      "(engine-declared program dispatches)",
+                      "(engine-declared program dispatches); the "
+                      "superstep arm adds dispatches/superstep and "
+                      "the amortized total",
         },
         "fused": measure(True),
         "staged": measure(False),
+        "superstep": measure(True, superstep=SUPERSTEP_SPAN),
     }
 
 
@@ -126,7 +148,7 @@ def audit(golden=None) -> tuple[list[str], list[str]]:
             "dispatch_ledger.json"
         )
         return failures, warnings
-    for arm in ("fused", "staged"):
+    for arm in ("fused", "staged", "superstep"):
         gold = golden.get(arm)
         if gold is None:
             failures.append(
@@ -134,7 +156,13 @@ def audit(golden=None) -> tuple[list[str], list[str]]:
                 "regenerate with --write-ledger"
             )
             continue
-        cur = measure(arm == "fused")
+        cur = measure(
+            arm != "staged",
+            superstep=(
+                gold.get("span", SUPERSTEP_SPAN)
+                if arm == "superstep" else 1
+            ),
+        )
         if cur["distinct"] != gold["distinct"]:
             failures.append(
                 f"[GL011] {arm}: measured run found {cur['distinct']} "
@@ -158,5 +186,35 @@ def audit(golden=None) -> tuple[list[str], list[str]]:
                 f"[GL011] {arm}: worst steady-state level dispatched "
                 f"{got} program(s), under the ledgered budget {budget} "
                 "— regenerate with --write-ledger and bank the win"
+            )
+        if arm != "superstep":
+            continue
+        # superstep budgets: every window must stay ONE program, and
+        # the run's amortized dispatch total (which encodes the
+        # <= 1/N-per-level steady state — the tiny run is
+        # deterministic, so the total is exact) must not grow
+        ss_budget = gold.get("max_dispatches_per_superstep", 1)
+        ss_got = cur.get("max_dispatches_per_superstep", 0)
+        if ss_got > ss_budget:
+            failures.append(
+                f"[GL011] superstep: a window dispatched {ss_got} "
+                f"device program(s), over the ledgered budget "
+                f"{ss_budget} — the multi-level driver regressed to "
+                "multiple programs per superstep"
+            )
+        tot_budget = gold.get("total_dispatches")
+        if tot_budget is not None and cur["total_dispatches"] > tot_budget:
+            failures.append(
+                f"[GL011] superstep: the measured run dispatched "
+                f"{cur['total_dispatches']} programs over "
+                f"{cur['levels']} levels, above the ledgered "
+                f"{tot_budget} — the amortized dispatches/level "
+                "regressed from the 1/N steady state"
+            )
+        elif tot_budget is not None and cur["total_dispatches"] < tot_budget:
+            warnings.append(
+                "[GL011] superstep: fewer total dispatches than "
+                "ledgered — regenerate with --write-ledger and bank "
+                "the win"
             )
     return failures, warnings

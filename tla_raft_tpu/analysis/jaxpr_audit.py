@@ -70,6 +70,7 @@ GL010_KERNELS = (
     "successor.materialize_legacy",
     "dense.expand",
     "engine.megakernel_level",
+    "engine.superstep",
 )
 
 
@@ -104,6 +105,7 @@ def kernel_registry():
     import jax.numpy as jnp
 
     from ..engine import megakernel as megakernel_mod
+    from ..engine import superstep as superstep_mod
     from ..models.raft import init_batch
     from ..ops import hashstore
     from ..ops.successor import get_kernel
@@ -161,6 +163,12 @@ def kernel_registry():
         # mix is frozen like every other hot kernel's
         "engine.megakernel_level":
             lambda: megakernel_mod.ledger_trace(cfg),
+        # the multi-level superstep driver (engine/superstep.py): the
+        # while_loop wraps the megakernel's fused_level_core, so the
+        # same gather budget pins its residue — plus the ring spool,
+        # which must stay drop-mode scatters (no data-indexed gathers)
+        "engine.superstep":
+            lambda: superstep_mod.ledger_trace(cfg),
     }
 
 

@@ -100,6 +100,13 @@ class DispatchLog:
         self._cur = 0
         self.per_level: list[int] = []
         self.tags: dict[str, int] = {}
+        # per-superstep accounting (engine/superstep.py): one entry per
+        # superstep dispatch window — (programs dispatched, levels
+        # covered).  The GL011 superstep budget and the bench's
+        # levels_per_dispatch stat read these.
+        self.per_superstep: list[int] = []
+        self.superstep_levels: list[int] = []
+        self._ss_mark: int | None = None
 
     def note(self, tag: str) -> None:
         self.total += 1
@@ -109,6 +116,20 @@ class DispatchLog:
     def tick(self) -> None:
         self.per_level.append(self._cur)
         self._cur = 0
+
+    def superstep_begin(self) -> None:
+        self._ss_mark = self.total
+
+    def superstep_tick(self, levels: int) -> None:
+        mark = self._ss_mark if self._ss_mark is not None else self.total
+        self.per_superstep.append(self.total - mark)
+        self.superstep_levels.append(int(levels))
+        self._ss_mark = None
+
+    def steady_max_superstep(self) -> int:
+        """Worst dispatches/superstep (each window is post-compile by
+        construction — the dispatch count is shape-independent)."""
+        return max(self.per_superstep) if self.per_superstep else 0
 
     def close(self) -> None:
         """Fold a trailing partial level (the fixpoint-discovery level
@@ -146,6 +167,25 @@ def note_dispatch(tag: str) -> None:
         CURRENT.note_dispatch(tag)
     if _DISPATCH_SINK is not None:
         _DISPATCH_SINK.note(tag)
+
+
+def superstep_begin() -> None:
+    """The engine is about to dispatch one multi-level superstep."""
+    if CURRENT is not None:
+        CURRENT.superstep_begin()
+    if _DISPATCH_SINK is not None:
+        _DISPATCH_SINK.superstep_begin()
+
+
+def superstep_tick(levels: int) -> None:
+    """One superstep's fetch completed, covering ``levels`` committed
+    levels — snapshots the dispatch/fetch counters for the
+    per-superstep ledger (the 1-dispatch-+-1-fetch-per-superstep
+    acceptance surface)."""
+    if CURRENT is not None:
+        CURRENT.superstep_tick(levels)
+    if _DISPATCH_SINK is not None:
+        _DISPATCH_SINK.superstep_tick(levels)
 
 
 def note_async_fetch_start() -> None:
@@ -224,6 +264,18 @@ class Sanitizer:
         self._gets_at_tick = 0
         self.per_level_dispatches: list[int] = []
         self.per_level_gets: list[int] = []
+        # per-SUPERSTEP dispatch/fetch windows (engine/superstep.py):
+        # the engine brackets each multi-level dispatch with
+        # superstep_begin/superstep_tick, and the acceptance claim —
+        # one device program + one ledgered fetch per superstep — is
+        # asserted from these (steady state: every window past the
+        # first, which may carry the compile-ladder's extra fetches)
+        self.n_supersteps = 0
+        self.superstep_levels = 0
+        self.per_superstep_dispatches: list[int] = []
+        self.per_superstep_gets: list[int] = []
+        self._ss_disp_mark: int | None = None
+        self._ss_gets_mark: int | None = None
         # async-pipeline fetch groups (engine/pipeline.py): every
         # copy_to_host_async group must complete through the ledgered
         # device_get path — started minus completed is the count of
@@ -406,6 +458,22 @@ class Sanitizer:
         self.n_dispatches += 1
         self._level_dispatches += 1
 
+    def superstep_begin(self) -> None:
+        self._ss_disp_mark = self.n_dispatches
+        self._ss_gets_mark = self.n_ledgered_get
+
+    def superstep_tick(self, levels: int) -> None:
+        dm = (self._ss_disp_mark if self._ss_disp_mark is not None
+              else self.n_dispatches)
+        gm = (self._ss_gets_mark if self._ss_gets_mark is not None
+              else self.n_ledgered_get)
+        self.per_superstep_dispatches.append(self.n_dispatches - dm)
+        self.per_superstep_gets.append(self.n_ledgered_get - gm)
+        self.n_supersteps += 1
+        self.superstep_levels += int(levels)
+        self._ss_disp_mark = None
+        self._ss_gets_mark = None
+
     def _steady(self, per_level: list[int]) -> list[int]:
         return per_level[self.warmup_levels:] or per_level
 
@@ -483,6 +551,18 @@ class Sanitizer:
             per_level_fetches=list(self.per_level_gets),
             steady_max_dispatches_per_level=max(sd) if sd else 0,
             steady_max_fetches_per_level=max(sg) if sg else 0,
+            supersteps=self.n_supersteps,
+            superstep_levels=self.superstep_levels,
+            per_superstep_dispatches=list(self.per_superstep_dispatches),
+            per_superstep_fetches=list(self.per_superstep_gets),
+            steady_max_dispatches_per_superstep=(
+                max(self.per_superstep_dispatches)
+                if self.per_superstep_dispatches else 0
+            ),
+            steady_max_fetches_per_superstep=(
+                max(self.per_superstep_gets)
+                if self.per_superstep_gets else 0
+            ),
             violations=list(self.violations),
         )
 
@@ -518,6 +598,19 @@ class Sanitizer:
             "per level.",
             file=out,
         )
+        if r["supersteps"]:
+            lvls = r["superstep_levels"]
+            avg = lvls / max(r["supersteps"], 1)
+            print(
+                f"Sanitizer: {r['supersteps']} supersteps covering "
+                f"{lvls} levels ({avg:.1f} levels/dispatch); "
+                f"steady-state max "
+                f"{r['steady_max_dispatches_per_superstep']} "
+                f"dispatch(es) and "
+                f"{r['steady_max_fetches_per_superstep']} ledgered "
+                "fetch(es) per superstep.",
+                file=out,
+            )
         for v in r["violations"]:
             print(f"Sanitizer: VIOLATION — {v}", file=out)
         print(

@@ -223,6 +223,7 @@ def summarize(res, chk=None, seconds: float | None = None) -> dict:
         level_sizes=list(res.level_sizes),
         mxu=getattr(chk, "use_mxu", None),
         megakernel=getattr(chk, "megakernel", None),
+        superstep=getattr(chk, "superstep_span", None),
         seconds=round(seconds, 3) if seconds is not None else None,
         violation=res.violation[0] if res.violation else None,
     )
@@ -266,6 +267,7 @@ def run_check(
     prewarm: bool | None = None,
     use_mxu: bool | None = None,
     megakernel: bool | None = None,
+    superstep: int | None = None,
     audit: int = 0,
     audit_retries: int = 3,
     watchdog: float = 0.0,
@@ -441,6 +443,7 @@ def run_check(
                     pipeline_window=pipeline_window,
                     use_mxu=use_mxu,
                     megakernel=megakernel,
+                    superstep=superstep,
                     prewarm=prewarm,
                     audit=audit,
                     audit_retries=audit_retries,
@@ -598,6 +601,16 @@ def main(argv=None) -> int:
                         "Single-device engine; the external-store path "
                         "fuses expand+dedup per group. env: "
                         "TLA_RAFT_MEGAKERNEL")
+    p.add_argument("--superstep", type=int, default=None, metavar="N",
+                   help="multi-level resident supersteps: run up to N "
+                        "consecutive fused levels inside ONE device "
+                        "program with ONE ledgered ring fetch "
+                        "(engine/superstep.py) — the dispatch floor "
+                        "amortizes to 1/N.  Default 4; "
+                        "1 reverts to the per-level megakernel "
+                        "(A/B — counts are bit-identical).  Requires "
+                        "the fused path (--megakernel 1); --audit "
+                        "forces per-level.  env: TLA_RAFT_SUPERSTEP")
     p.add_argument("--no-hashstore", action="store_true",
                    help="revert to the sort-based visited path (lexsort "
                         "+ searchsorted + sorted merge) instead of the "
@@ -722,6 +735,7 @@ def main(argv=None) -> int:
             megakernel=(
                 None if args.megakernel is None else bool(args.megakernel)
             ),
+            superstep=args.superstep,
             audit=args.audit,
             audit_retries=args.audit_retries,
             watchdog=args.watchdog,

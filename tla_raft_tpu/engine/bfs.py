@@ -72,6 +72,7 @@ from ..models.raft import RaftState, init_batch, to_oracle
 from ..ops import hashstore
 from ..ops.successor import SuccessorKernel, get_kernel
 from . import megakernel as graft_megakernel
+from . import superstep as graft_superstep
 from . import pipeline as graft_pipeline
 from .forecast import MIN_LEVELS as PRESIZE_MIN_LEVELS, pow2ceil as _pow2
 from .invariants import resolve_invariant_kernel
@@ -462,6 +463,7 @@ class JaxChecker:
         prewarm: bool | None = None,
         use_mxu: bool | None = None,
         megakernel: bool | None = None,
+        superstep: int | None = None,
         audit: int = 0,
         audit_retries: int = 3,
         watchdog=None,
@@ -651,6 +653,23 @@ class JaxChecker:
         self._mega_stats = dict(
             levels=0, redo_out=0, redo_x=0, redo_slab=0, redo_m=0,
         )
+        # multi-level resident supersteps (engine/superstep.py): run up
+        # to N fused levels inside ONE device program + ONE ledgered
+        # ring fetch wherever the per-level megakernel is eligible.
+        # Default span DEFAULT_SPAN; --superstep 1 / TLA_RAFT_SUPERSTEP
+        # reverts to the per-level fused path.  The --audit legacy
+        # re-expansion needs every level's parent frontier on device,
+        # which the resident loop consumes — audit runs stay per-level.
+        if superstep is None:
+            superstep = graft_superstep.span_from_env()
+        self.superstep_span = (
+            max(1, int(superstep)) if self.megakernel and not audit
+            else 1
+        )
+        self._ss_stats = dict(
+            supersteps=0, levels=0, stops=0, ring_stops=0,
+        )
+        self._ss_sig = None  # declared superstep static-shape signature
         self._degraded_visited = None  # sorted store handoff on degrade
         # semantic run fingerprint for the checkpoint manifests: spec
         # constants only — NOT tunables like chunk (a resume may retune
@@ -708,6 +727,13 @@ class JaxChecker:
         if getattr(self, "_mega_flag", False) and not self.orbit:
             self._expand_group_fused = jax.jit(
                 self._expand_group_fused_impl
+            )
+            # grouped ultra-deep regime: span + visited pre-filter in
+            # one program per group (cap_g static so its growth
+            # retraces like the staged _group_filter_hash)
+            self._expand_group_gfused = jax.jit(
+                self._expand_group_gfused_impl,
+                static_argnames=("cap_g",),
             )
 
     # -- sparse <-> dense message-set conversion ---------------------------
@@ -1023,6 +1049,26 @@ class JaxChecker:
         )
         return n_u, gv, gf, gp, mult, ab, ovf
 
+    def _expand_group_gfused_impl(self, seg, slice_base, global_base,
+                                  n_f, hslab, cap_g: int):
+        """The grouped ultra-deep regime's per-group chain — G-chunk
+        span expand + the visited PRE-FILTER (hash probe + compact) —
+        in ONE program per group (the staged chain was two).  The
+        filter body is the SAME probe + ``_filter_compact`` tail as
+        ``_group_filter_hash``, so outputs are bit-identical; the
+        pre-filter stays in place because it is what bounds the
+        candidate working set to O(groups * cap_g) in this regime
+        (the whole-level fusion deliberately does not apply here)."""
+        cvs, cfs, cps, mult, ab, ovf = self._expand_span_impl(
+            seg, slice_base, global_base, n_f
+        )
+        hit = hashstore.probe_impl(hslab, cvs.reshape(-1))
+        gv, gf, gp, ovf_g = _filter_compact(
+            hit, cvs.reshape(-1), cfs.reshape(-1), cps.reshape(-1),
+            cap_g,
+        )
+        return gv, gf, gp, mult, ab, ovf, ovf_g
+
     # -- whole-level megakernel (engine/megakernel.py) ---------------------
 
     def _mega_level_ok(self, frontier, n_f) -> bool:
@@ -1189,6 +1235,145 @@ class JaxChecker:
             pidx=np.asarray(pidx_np)[:n_new].astype(np.int64),
             slot=np.asarray(slot_np)[:n_new].astype(np.int64),
             cap_out=cap_out,
+        )
+
+    # -- multi-level resident supersteps (engine/superstep.py) -------------
+
+    def _superstep_span_at(self, max_depth, depth) -> int:
+        """The span this superstep may cover: the configured span,
+        clamped so the resident loop never expands past --max-depth
+        (the per-level loop breaks BEFORE expanding at the cap)."""
+        span = self.superstep_span
+        if max_depth is not None:
+            span = min(span, max_depth - depth)
+        return span
+
+    def _superstep_shapes(self, fut, span, n_rows, cap_cur):
+        """One superstep window's static ``(cap_f, ring)`` — the ONE
+        copy of the shape math shared by ``_run_superstep`` and the
+        prewarm walk, so the AOT ``("sstep", ...)`` keys always match
+        the shapes the runtime requests (a desynchronized margin would
+        compile dead programs and pay every window's XLA compile
+        synchronously)."""
+        if fut:
+            # same margins as the per-level _mega_cap_out, applied to
+            # the span max: one static seat for every level in flight
+            est = max(int(max(fut) * 1.25) + 1, 2 * max(n_rows, 1))
+        else:
+            est = 4 * max(n_rows, 1)  # early fan-out bound
+        cap_f = max(
+            self._frontier_cap(est), 4 * self.chunk, cap_cur,
+        )
+        # resident levels must stay inside the grouping threshold: a
+        # frontier the per-level loop would route grouped-staged
+        # (n_chunks > 16*G, _mega_level_ok) must never be expanded
+        # resident mid-span — cap the seats so such a level overflows
+        # FLAG_OVF_OUT (a clean stop) and re-enters the per-level
+        # routing, which sends it grouped-staged like the level loop
+        cap_f = min(cap_f, max(16 * self.G * self.chunk,
+                               4 * self.chunk, cap_cur))
+        ring = graft_superstep.ring_capacity(fut, span, cap_f, _pow2)
+        return cap_f, ring
+
+    def _run_superstep(self, frontier, n_f, max_depth, depth,
+                       level_sizes):
+        """ONE device dispatch + ONE ledgered ring fetch for up to N
+        consecutive levels.  Returns the committed per-level records
+        (the same delta/trace record shape the per-level megakernel
+        fetch produces), the carried frontier (the stopped level's
+        parent on a STOP), the pending slab and the stop reason; the
+        caller adopts the prefix and routes any stopped level through
+        the per-level machinery."""
+        from .forecast import forecast_new_states
+
+        ss = graft_superstep
+        # span: the EFFECTIVE level bound this window may cover (the
+        # --max-depth clamp) — a traced operand of the program, so one
+        # compiled span-N driver serves every remainder
+        span = self._superstep_span_at(max_depth, depth)
+        cap_cur = frontier.voted_for.shape[0]
+        fut = forecast_new_states(level_sizes, max_depth)[:span]
+        # shape statics always use the CONFIGURED span (the clamped
+        # span is only the traced lvl_cap operand below), so a
+        # --max-depth remainder window reuses the span-N program AND
+        # the prewarmed ("sstep", ...) ring/cap_f rungs instead of
+        # minting a one-off smaller-ring compile
+        cap_f, ring = self._superstep_shapes(
+            fut, self.superstep_span, n_f, cap_cur
+        )
+        # slab headroom for the WHOLE span: a superstep inserts up to
+        # the sum of its levels' new states before the host can grow
+        # the store, so the between-superstep reserve must budget the
+        # span's forecast inserts (margined like the ring rungs), not
+        # one level's — otherwise every growing span stops on a probe-
+        # window fill and replays per-level, eroding the amortization.
+        # reserve() grows to FIT (a single doubling can be short of a
+        # 4-level span on a >2x-growth run).
+        if fut:
+            ins_bound = sum(
+                min(int(f * 1.25) + 1, cap_f) for f in fut
+            )
+        else:
+            ins_bound = 2 * max(n_f, 1)
+        try:
+            self.hstore.reserve(
+                self.hstore.count + max(ins_bound, 2 * max(n_f, 1))
+            )
+        except Exception as e:  # graftlint: waive[GL003] — grow
+            # failure degrades to the sort path like every other
+            # grow site; the caller redoes the level staged
+            self._degraded_visited = self._degrade_hashstore(e)
+            return dict(degraded=True, frontier=frontier)
+        prog = ss.superstep_program_for(
+            self, self.superstep_span, self._mega_donate
+        )
+        # cap_cur (the input frontier's capacity) is part of the traced
+        # shape via the in-program padding — a changed input rung is a
+        # declared shape event like every other capacity step
+        skey = (cap_cur, cap_f, ring, self.hstore.cap,
+                self.cap_x, self.cap_m)
+        if graft_sanitize.tracking() and skey != self._ss_sig:
+            graft_sanitize.note_shape_event(f"superstep shapes {skey}")
+            self._ss_sig = skey
+        graft_sanitize.superstep_begin()
+        outs = prog(
+            frontier, self.hstore.slab, jnp.asarray(n_f, I64),
+            jnp.asarray(span, I64),
+            cap_f=cap_f, ring=ring,
+        )
+        (fr_out, slab_out, ctrl_d, mn_d, mm_d, rf_d, rp_d,
+         rs_d) = outs
+        graft_sanitize.note_dispatch("superstep.levels")
+        self._san_lanes = (cap_f // self.chunk) * self.cap_x
+        # THE superstep fetch: control vector + per-level meta + the
+        # trace/delta ring in one ledgered get through the pipeline's
+        # deferred path (transfer ledger, pipeline.window fault site
+        # and the watchdog heartbeat all still see it)
+        tail = graft_pipeline.DeferredFetch(
+            self.pipeline, (ctrl_d, mn_d, mm_d, rf_d, rp_d, rs_d)
+        )
+        ctrl, mn, mm, rf, rp, rs = tail.get()
+        recs, reason, n_f_out, slab_live, flags = ss.unpack_ring(
+            ctrl, mn, mm, rf, rp, rs
+        )
+        graft_sanitize.superstep_tick(len(recs))
+        self._ss_stats["supersteps"] += 1
+        self._ss_stats["levels"] += len(recs)
+        if reason == "stop":
+            self._ss_stats["stops"] += 1
+        elif reason == "ring":
+            self._ss_stats["ring_stops"] += 1
+        return dict(
+            recs=recs,
+            frontier=fr_out,
+            slab=slab_out,
+            n_total=sum(r["n_new"] for r in recs),
+            n_f=n_f_out,
+            reason=reason,
+            slab_live=slab_live,
+            flags=flags,
+            cap_f=cap_f,
+            span=span,
         )
 
     def _inv_scan_impl(self, children: RaftState, n_valid):
@@ -1675,7 +1860,53 @@ class JaxChecker:
                     break
                 mega_rows += 1
                 prev = int(r)
-        if mega_rows:
+        if mega_rows and self.superstep_span > 1:
+            # superstep path: the multi-level driver's shape ladder
+            # REPLACES the per-level fused keys (those programs are
+            # dead while supersteps are on — compiling them would pay
+            # compile time for nothing).  The walk mirrors
+            # _run_superstep exactly: span-sized windows over the raw
+            # forecast, one static cap_f per window (max rung, same
+            # margins), the ring chained from the window's cap_out
+            # sequence, the input rung chained from the previous
+            # window's cap_f.
+            from .forecast import forecast_new_states
+
+            span = self.superstep_span
+            scaps = slab_ladder()
+            fut_all = forecast_new_states(level_sizes, max_depth)
+            prev_cap = frontier.voted_for.shape[0]
+            prev_rows = max(int(level_sizes[-1]), 1)
+            s_i64_n = jax.ShapeDtypeStruct((), jnp.int64)
+            i = 0
+            while i < mega_rows:
+                fut_w = fut_all[i:i + span]
+                if not fut_w:
+                    break
+                cap_f, ring = self._superstep_shapes(
+                    fut_w, span, prev_rows, prev_cap
+                )
+                prog = graft_superstep.superstep_program_for(
+                    self, span, self._mega_donate
+                )
+                fs = self._frontier_struct(frontier, prev_cap)
+                for sc in scaps:
+                    plan.append((
+                        ("sstep", prev_cap, cap_f, ring, sc, span,
+                         self.cap_x, self.cap_m, self.use_mxu),
+                        lambda fs=fs, sc=sc, cap_f=cap_f, ring=ring,
+                               prog=prog:
+                            prog.lower(
+                                fs, u64(sc), s_i64_n, s_i64_n,
+                                cap_f=cap_f, ring=ring,
+                            ).compile(),
+                    ))
+                prev_cap = cap_f
+                prev_rows = max(int(fut_w[-1]), 1)
+                i += span
+            if mega_rows == len(rows):
+                return plan
+        elif mega_rows:
             # fused path: the megakernel ladder replaces the staged
             # span/dedup/gfilter program set for these rows — each
             # forecast level's program is keyed by (input cap, output
@@ -2435,8 +2666,10 @@ class JaxChecker:
         self.hstore = None
         self._hs_pending = None
         # the fused level program IS a hash-store consumer — the sorted
-        # path runs staged for the rest of the run
+        # path runs staged for the rest of the run (and with it the
+        # multi-level superstep driver, which wraps the fused body)
         self.megakernel = False
+        self.superstep_span = 1
         return visited
 
     def _check_fp_def(self, fp_def: int, path: str) -> None:
@@ -2584,8 +2817,38 @@ class JaxChecker:
         if (self.chunk >= self.span_min_chunk and n_chunks >= G
                 and not self.orbit):
             span_rows = G * self.chunk
+            # grouped ultra-deep levels with the hash store: the whole
+            # per-group staged chain (span expand + visited pre-filter
+            # + compact) fuses into ONE program per group under the
+            # megakernel flag — the regime the whole-level fusion
+            # deliberately leaves staged (the pre-filter bounds the
+            # candidate working set there)
+            gfused = (
+                grouping and use_hs
+                and getattr(self, "_expand_group_gfused", None)
+                is not None
+            )
             for g in range(n_chunks // G):
                 b = jnp.asarray(g * span_rows, I64)
+                if gfused:
+                    (gv, gf, gp, mult_s, ab_s, ovf_s,
+                     ovf_g) = self._expand_group_gfused(
+                        frontier, b, b, n_f_dev, hslab,
+                        cap_g=self.cap_g,
+                    )
+                    graft_sanitize.note_dispatch("device.span_gfused")
+                    mult_acc = mult_acc + mult_s
+                    abort_at = jnp.minimum(abort_at, ab_s)
+                    overflow = overflow | ovf_s
+                    overflow_g = overflow_g | ovf_g
+                    gvs.append(gv)
+                    gfs.append(gf)
+                    gps.append(gp)
+                    synced += 1
+                    if synced >= self.sync_every:
+                        jax.device_get(abort_at)
+                        synced = 0
+                    continue
                 cvs_s, cfs_s, cps_s, mult_s, ab_s, ovf_s = self._expand_span(
                     frontier, b, b, n_f_dev
                 )
@@ -3201,6 +3464,10 @@ class JaxChecker:
                 for s in frontier
             ]
 
+        # a STOPPED superstep (uncommitted abort/violation/overflow
+        # level) must route its level through the per-level machinery
+        # exactly once before supersteps re-engage
+        skip_superstep = False
         while n_f > 0:
             resilience.fault_fire("level.start")
             if resilience.preempt_requested():
@@ -3255,6 +3522,184 @@ class JaxChecker:
                 self._submit_prewarm(
                     level_sizes, distinct, max_depth, frontier, visited
                 )
+            # --- multi-level resident superstep: up to N fused levels
+            # in ONE device program + ONE ledgered ring fetch
+            # (engine/superstep.py).  A stopped level (abort /
+            # violation / any overflow / ring high-water) falls
+            # through to the per-level paths below, which re-enter the
+            # existing grow-and-redo machinery against the slab as of
+            # the committed prefix --------------------------------------
+            if (not skip_superstep
+                    and self._superstep_span_at(max_depth, depth) > 1
+                    and self._mega_level_ok(frontier, n_f)):
+                if self.watchdog is not None:
+                    # the armed deadline scales with the declared level
+                    # span (satellite: an N-level superstep must not
+                    # trip the per-level hang budget)
+                    self.watchdog.arm(
+                        f"levels {depth + 1}..{depth + self._superstep_span_at(max_depth, depth)}"
+                        " (superstep)",
+                        span=self._superstep_span_at(max_depth, depth),
+                    )
+                sres = self._run_superstep(
+                    frontier, n_f, max_depth, depth, level_sizes
+                )
+            else:
+                sres = None
+            skip_superstep = False
+            if sres is not None and sres.get("degraded"):
+                # hash store degraded while presizing for the span:
+                # adopt the rebuilt sorted store and run staged
+                frontier = sres["frontier"]
+                visited = self._degraded_visited
+                self._degraded_visited = None
+                sres = None
+                if self.watchdog is not None:
+                    # the span-N window must not cover the staged
+                    # single level below: its end-of-level disarm
+                    # would divide one level's wall by N and deflate
+                    # the adaptive budget right when the degraded
+                    # (sorted-store) levels run slowest
+                    self.watchdog.arm(f"level {depth + 1} (degraded)")
+            if sres is not None:
+                frontier = sres["frontier"]
+                hit_fixpoint = False
+                depth0 = depth  # window entry, for the dump cadence
+                for li, rec in enumerate(sres["recs"]):
+                    if li:
+                        # the per-level crash sites keep their once-
+                        # per-level cadence (the while-loop top fired
+                        # for the superstep's first level)
+                        resilience.fault_fire("level.start")
+                    level_mult = rec["mult"]
+                    mult_per_slot = mult_per_slot + level_mult
+                    generated += int(level_mult.sum())
+                    if rec["n_new"] == 0:
+                        # the terminal fixpoint level: generated counts
+                        # (the staged loop breaks AFTER the mult add),
+                        # distinct/depth do not
+                        hit_fixpoint = True
+                        break
+                    n_new = rec["n_new"]
+                    distinct += n_new
+                    level_sizes.append(n_new)
+                    depth += 1
+                    trace_levels.append((rec["pidx"], rec["slot"]))
+                    n_f = n_new
+                    if self.progress is not None:
+                        self.progress(
+                            dict(
+                                level=depth,
+                                frontier=n_new,
+                                distinct=distinct,
+                                generated=generated,
+                                elapsed=time.monotonic() - t0,
+                            )
+                        )
+                    if graft_sanitize.tracking():
+                        sig = (
+                            sres["cap_f"], self.hstore.cap,
+                            sres["cap_f"], self.cap_x, self.cap_g,
+                            self.cap_m, self._san_lanes,
+                        )
+                        if sig != getattr(self, "_san_sig", None):
+                            graft_sanitize.note_shape_event(
+                                f"level shapes {sig}"
+                            )
+                            self._san_sig = sig
+                        graft_sanitize.level_tick()
+                    if checkpoint_dir and checkpoint_every:
+                        self._save_delta(
+                            checkpoint_dir, depth, rec["pidx"],
+                            rec["slot"], rec["fps"], level_mult, n_new,
+                        )
+                if sres["n_total"] or hit_fixpoint:
+                    # adopt the committed prefix's slab in one step
+                    self.hstore.adopt(sres["slab"], sres["n_total"])
+                    # free conservation check: the driver counted the
+                    # returned slab's live slots — they must equal the
+                    # distinct set after the committed prefix
+                    resilience.integrity.occupancy_check(
+                        "device hash slab", sres["slab_live"], distinct,
+                        level=depth,
+                    )
+                if checkpoint_dir and checkpoint_every and sres["recs"]:
+                    dump_every = hashstore.dump_interval(
+                        self.hstore.cap * 8
+                    ) if self.use_hashstore else 0
+                    # floor-crossing, not ==: the window advanced depth
+                    # by up to span levels, and any cadence point it
+                    # crossed earns the (one, end-of-window) dump —
+                    # keeping the per-level path's snapshot cadence
+                    if (self.use_hashstore and dump_every
+                            and (depth // dump_every)
+                            > (depth0 // dump_every)):
+                        self.hstore.dump(
+                            os.path.join(checkpoint_dir, "hslab.npz"),
+                            depth, int(self.orbit),
+                            run_fp=self._run_fp,
+                        )
+                if hit_fixpoint:
+                    if self.watchdog is not None:
+                        self.watchdog.disarm(levels=len(sres["recs"]))
+                    break
+                if sres["reason"] == "stop" or (
+                    sres["reason"] == "ring" and not sres["recs"]
+                ):
+                    # a zero-commit window (uncommitted stop level, or
+                    # a ring too small for even one level) must make
+                    # progress through the per-level path before the
+                    # next superstep engages
+                    skip_superstep = True
+                if sres["reason"] == "stop":
+                    # the control vector names the stopped level's
+                    # overflow class — grow the budget NOW so the
+                    # per-level replay lands on its first redo instead
+                    # of re-discovering the overflow (a stopped level
+                    # then costs one attempt + one redo, exactly the
+                    # per-level path's price)
+                    flags = sres["flags"]
+                    if flags & graft_superstep.FLAG_OVF_X:
+                        self.cap_x = _cap_steps(self.cap_x + 1)
+                        self.cap_g = max(
+                            self.cap_g, self.G * self.cap_x // 2
+                        )
+                        self._jit_expand_programs()
+                        self._mega_stats["redo_x"] += 1
+                    if flags & graft_superstep.FLAG_OVF_SLAB:
+                        self._hs_pending = None
+                        try:
+                            self.hstore.grow()
+                        except Exception as e:  # graftlint: waive[GL003]
+                            # grow failure degrades to the sort path
+                            # like every other grow site
+                            visited = self._degrade_hashstore(e)
+                        else:
+                            self._mega_stats["redo_slab"] += 1
+                    if (flags & graft_superstep.FLAG_OVF_M
+                            and self.cap_m < self.kern.uni.M):
+                        # mirror the per-level cap_m redo (widen + re-
+                        # jit) so the replay's first attempt lands
+                        # under the grown width; at the universe cap
+                        # the replay raises through its own error path
+                        self.cap_m = min(
+                            self.cap_m + 32, self.kern.uni.M
+                        )
+                        print(
+                            f"[engine] cap_m overflow: growing to "
+                            f"{self.cap_m} and replaying the stopped "
+                            "level per-level", file=sys.stderr,
+                        )
+                        frontier = self._widen_msg_ids(frontier)
+                        self._jit_expand_programs()
+                        self._mega_stats["redo_m"] += 1
+                if self.watchdog is not None:
+                    # a stopped window's elapsed covered only the
+                    # committed levels (+ the aborted attempt): keep
+                    # the per-level history honest or the stopped
+                    # level's own replay budget deflates
+                    self.watchdog.disarm(levels=len(sres["recs"]))
+                continue
             # --- whole-level megakernel: ONE fused program + ONE
             # ledgered fetch per level (engine/megakernel.py); every
             # overflow redoes inside, a mid-level hash-store

@@ -158,6 +158,135 @@ def level_program_for(eng, donate: bool):
     return prog
 
 
+def fused_level_core(eng, frontier, slab, n_f, cap_out: int,
+                     chunk: int, cap_x: int):
+    """The traced body of ONE fused BFS level — the shared core both the
+    per-level program below and the multi-level superstep driver
+    (engine/superstep.py) trace, so the two paths can never drift on
+    the level semantics (same expand while_loop, same probe-and-insert,
+    same materialize scan, same invariant reduce).
+
+    ``chunk``/``cap_x`` are the builder's SNAPSHOT of the engine's
+    budgets (the staleness tripwire in the callers compares them
+    against the live engine before tracing).  Returns
+    ``(new_frontier [cap_out], slab2, n_new i64, abort_at i64,
+       ovf_x bool, ovf_slab bool, ovf_m bool, bad_global i64,
+       mult i64[K], fps_out u64[cap_out], pay_out i64[cap_out])``
+    with ``pay_out`` the survivors' raw payloads (pidx*K+slot) in lane
+    (= payload-ascending) order.
+    """
+    from ..ops import hashstore
+
+    K = eng.K
+    cap_f = frontier.voted_for.shape[0]
+    n_chunks = cap_f // chunk
+    N = n_chunks * cap_x  # level-wide candidate lane budget
+
+    # -- 1. chunked expand: while_loop with a data-bounded trip
+    # count over static shapes — dead chunks beyond n_f never run
+    def cond(c):
+        i = c[0]
+        return i.astype(I64) * chunk < n_f
+
+    def body(c):
+        i, cv_b, cf_b, cp_b, mult, ab, ovf = c
+        start = i.astype(I64) * chunk
+        part = jax.tree.map(
+            lambda x: jax.lax.dynamic_slice_in_dim(
+                x, i * chunk, chunk
+            ),
+            frontier,
+        )
+        cv, cf, cp, m, a, o = eng._expand_chunk_impl(part, start, n_f)
+        off = i * cap_x
+        cv_b = jax.lax.dynamic_update_slice(cv_b, cv, (off,))
+        cf_b = jax.lax.dynamic_update_slice(cf_b, cf, (off,))
+        cp_b = jax.lax.dynamic_update_slice(cp_b, cp, (off,))
+        return (
+            i + 1, cv_b, cf_b, cp_b,
+            mult + m, jnp.minimum(ab, a), ovf | o,
+        )
+
+    init = (
+        jnp.zeros((), I32),
+        jnp.full((N,), SENT, U64),
+        jnp.full((N,), SENT, U64),
+        jnp.full((N,), -1, I64),
+        jnp.zeros((K,), I64),
+        jnp.asarray(BIG, I64),
+        jnp.zeros((), bool),
+    )
+    (_i, cv_buf, cf_buf, cp_buf, mult, abort_at,
+     ovf_x) = jax.lax.while_loop(cond, body, init)
+
+    # -- 2. fused probe-and-insert: uniqueness + membership + store
+    # update in one pass; fresh lanes compact to a prefix in LANE
+    # (= payload-ascending) order, the staged path's exact contract
+    slab2, fresh, n_new, ovf_slab = hashstore.probe_and_insert_impl(
+        slab, cv_buf, cf_buf, cp_buf
+    )
+    new_fps, new_pay = hashstore.compact_fresh(fresh, cv_buf, cp_buf, N)
+    if cap_out > N:
+        # tiny cap_x configs: the frontier-capacity quantizer's
+        # >= chunk floor can exceed the lane budget — pad with dead
+        # lanes (n_new <= N always, so nothing real is cut)
+        new_fps = jnp.concatenate(
+            [new_fps, jnp.full((cap_out - N,), SENT, U64)]
+        )
+        new_pay = jnp.concatenate(
+            [new_pay, jnp.full((cap_out - N,), -1, I64)]
+        )
+    fps_out = new_fps[:cap_out]
+    pay_out = new_pay[:cap_out]
+
+    # -- 3+4. materialize + invariant scan over slice-bounded scan
+    # steps.  cap_out is a forecast (it overshoots n_new by design,
+    # that is what makes the shape static), so slices wholly beyond
+    # n_new are SKIPPED via lax.cond — the scan body is sequential,
+    # the dead branch emits zeros (exactly the staged path's
+    # zero-padded frontier tail) and the overshoot costs nothing
+    sl = mat_slice_width(cap_out, chunk)
+    n_slices = cap_out // sl
+
+    def live_slice(args):
+        pay_slice, take = args
+        return eng._mat_slice_impl(frontier, pay_slice, take)
+
+    def dead_slice(args):
+        pay_slice, _take = args
+        child = jax.tree.map(
+            lambda x: jnp.zeros(
+                (sl,) + x.shape[1:], x.dtype
+            ),
+            frontier,
+        )
+        return child, jnp.asarray(-1, I64), jnp.zeros((), bool)
+
+    def mat_body(_carry, si):
+        pay_slice = jax.lax.dynamic_slice_in_dim(pay_out, si * sl, sl)
+        take = jnp.clip(n_new - si.astype(I64) * sl, 0, sl)
+        child, bad_at, ovf_m = jax.lax.cond(
+            take > 0, live_slice, dead_slice, (pay_slice, take)
+        )
+        return _carry, (child, bad_at, ovf_m)
+
+    _c, (children, bad_ats, ovf_ms) = jax.lax.scan(
+        mat_body, jnp.zeros((), I32), jnp.arange(n_slices, dtype=I32)
+    )
+    new_frontier = jax.tree.map(
+        lambda x: x.reshape((cap_out,) + x.shape[2:]), children
+    )
+    # first bad global index: slices stack in order, so the minimum
+    # of (si*sl + first_bad_in_slice) IS the first bad overall
+    sli = jnp.arange(n_slices, dtype=I64)
+    badg = jnp.where(bad_ats >= 0, sli * sl + bad_ats, BIG)
+    bad_min = badg.min()
+    bad_global = jnp.where(bad_min >= BIG, jnp.asarray(-1, I64), bad_min)
+
+    return (new_frontier, slab2, n_new, abort_at, ovf_x, ovf_slab,
+            ovf_ms.any(), bad_global, mult, fps_out, pay_out)
+
+
 def build_level_program(eng, donate: bool):
     """The jitted whole-level program for one engine configuration.
 
@@ -176,8 +305,6 @@ def build_level_program(eng, donate: bool):
     aliasing makes it zero-copy; it keeps the parent frontier alive for
     redo and audit).
     """
-    from ..ops import hashstore
-
     chunk = eng.chunk
     cap_x = eng.cap_x
     K = eng.K
@@ -197,117 +324,17 @@ def build_level_program(eng, donate: bool):
                 f"changed (cap_x {cap_x}->{eng.cap_x}, chunk "
                 f"{chunk}->{eng.chunk}); re-fetch via level_program_for"
             )
-        cap_f = frontier.voted_for.shape[0]
-        n_chunks = cap_f // chunk
-        N = n_chunks * cap_x  # level-wide candidate lane budget
-
-        # -- 1. chunked expand: while_loop with a data-bounded trip
-        # count over static shapes — dead chunks beyond n_f never run
-        def cond(c):
-            i = c[0]
-            return i.astype(I64) * chunk < n_f
-
-        def body(c):
-            i, cv_b, cf_b, cp_b, mult, ab, ovf = c
-            start = i.astype(I64) * chunk
-            part = jax.tree.map(
-                lambda x: jax.lax.dynamic_slice_in_dim(
-                    x, i * chunk, chunk
-                ),
-                frontier,
-            )
-            cv, cf, cp, m, a, o = eng._expand_chunk_impl(part, start, n_f)
-            off = i * cap_x
-            cv_b = jax.lax.dynamic_update_slice(cv_b, cv, (off,))
-            cf_b = jax.lax.dynamic_update_slice(cf_b, cf, (off,))
-            cp_b = jax.lax.dynamic_update_slice(cp_b, cp, (off,))
-            return (
-                i + 1, cv_b, cf_b, cp_b,
-                mult + m, jnp.minimum(ab, a), ovf | o,
-            )
-
-        init = (
-            jnp.zeros((), I32),
-            jnp.full((N,), SENT, U64),
-            jnp.full((N,), SENT, U64),
-            jnp.full((N,), -1, I64),
-            jnp.zeros((K,), I64),
-            jnp.asarray(BIG, I64),
-            jnp.zeros((), bool),
+        (new_frontier, slab2, n_new, abort_at, ovf_x, ovf_slab, ovf_m,
+         bad_global, mult, fps_out, pay_out) = fused_level_core(
+            eng, frontier, slab, n_f, cap_out, chunk, cap_x
         )
-        (_i, cv_buf, cf_buf, cp_buf, mult, abort_at,
-         ovf_x) = jax.lax.while_loop(cond, body, init)
-
-        # -- 2. fused probe-and-insert: uniqueness + membership + store
-        # update in one pass; fresh lanes compact to a prefix in LANE
-        # (= payload-ascending) order, the staged path's exact contract
-        slab2, fresh, n_new, ovf_slab = hashstore.probe_and_insert_impl(
-            slab, cv_buf, cf_buf, cp_buf
-        )
-        new_fps, new_pay = hashstore.compact_fresh(fresh, cv_buf, cp_buf, N)
-        if cap_out > N:
-            # tiny cap_x configs: the frontier-capacity quantizer's
-            # >= chunk floor can exceed the lane budget — pad with dead
-            # lanes (n_new <= N always, so nothing real is cut)
-            new_fps = jnp.concatenate(
-                [new_fps, jnp.full((cap_out - N,), SENT, U64)]
-            )
-            new_pay = jnp.concatenate(
-                [new_pay, jnp.full((cap_out - N,), -1, I64)]
-            )
-        fps_out = new_fps[:cap_out]
-        pay_out = new_pay[:cap_out]
-
-        # -- 3+4. materialize + invariant scan over slice-bounded scan
-        # steps.  cap_out is a forecast (it overshoots n_new by design,
-        # that is what makes the shape static), so slices wholly beyond
-        # n_new are SKIPPED via lax.cond — the scan body is sequential,
-        # the dead branch emits zeros (exactly the staged path's
-        # zero-padded frontier tail) and the overshoot costs nothing
-        sl = mat_slice_width(cap_out, chunk)
-        n_slices = cap_out // sl
-
-        def live_slice(args):
-            pay_slice, take = args
-            return eng._mat_slice_impl(frontier, pay_slice, take)
-
-        def dead_slice(args):
-            pay_slice, _take = args
-            child = jax.tree.map(
-                lambda x: jnp.zeros(
-                    (sl,) + x.shape[1:], x.dtype
-                ),
-                frontier,
-            )
-            return child, jnp.asarray(-1, I64), jnp.zeros((), bool)
-
-        def mat_body(_carry, si):
-            pay_slice = jax.lax.dynamic_slice_in_dim(pay_out, si * sl, sl)
-            take = jnp.clip(n_new - si.astype(I64) * sl, 0, sl)
-            child, bad_at, ovf_m = jax.lax.cond(
-                take > 0, live_slice, dead_slice, (pay_slice, take)
-            )
-            return _carry, (child, bad_at, ovf_m)
-
-        _c, (children, bad_ats, ovf_ms) = jax.lax.scan(
-            mat_body, jnp.zeros((), I32), jnp.arange(n_slices, dtype=I32)
-        )
-        new_frontier = jax.tree.map(
-            lambda x: x.reshape((cap_out,) + x.shape[2:]), children
-        )
-        # first bad global index: slices stack in order, so the minimum
-        # of (si*sl + first_bad_in_slice) IS the first bad overall
-        sli = jnp.arange(n_slices, dtype=I64)
-        badg = jnp.where(bad_ats >= 0, sli * sl + bad_ats, BIG)
-        bad_min = badg.min()
-        bad_global = jnp.where(bad_min >= BIG, jnp.asarray(-1, I64), bad_min)
 
         ctrl = jnp.stack([
             n_new.astype(I64),
             abort_at,
             ovf_x.astype(I64),
             ovf_slab.astype(I64),
-            ovf_ms.any().astype(I64),
+            ovf_m.astype(I64),
             bad_global,
             (slab2 != SENT).sum().astype(I64),
         ])

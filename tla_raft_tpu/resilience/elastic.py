@@ -209,9 +209,18 @@ class Watchdog:
             )
             self._thread.start()
 
-    def arm(self, context: str) -> None:
+    def arm(self, context: str, span: int = 1) -> None:
+        """Arm one dispatch window covering ``span`` BFS levels.
+
+        The history holds PER-LEVEL wall times (``disarm`` divides a
+        window's elapsed time by its declared span before recording),
+        so a multi-level superstep earns ``span`` times the per-level
+        adaptive budget instead of tripping the single-level one —
+        and the budgets stay comparable when the run switches between
+        superstep and per-level windows."""
+        span = max(1, int(span))
         last = self._hist[-1] if self._hist else 0.0
-        budget = max(self.floor, self.mult * last)
+        budget = span * max(self.floor, self.mult * last)
         if not self._hist:
             # the first armed level of a (re)launched process pays the
             # cold compile ladder with no history and (pre-pipeline)
@@ -219,10 +228,10 @@ class Watchdog:
             # relaunch could hard-kill it mid-compile every time and
             # make zero progress — give the cold level the same
             # multiplier headroom an adaptive level would get
-            budget = max(budget, self.mult * self.floor)
+            budget = max(budget, span * self.mult * self.floor)
         with self._cv:
             self._armed = dict(
-                context=context, budget=budget,
+                context=context, budget=budget, span=span,
                 started=time.monotonic(),
                 deadline=time.monotonic() + budget,
             )
@@ -237,7 +246,7 @@ class Watchdog:
             if a is not None:
                 a["deadline"] = time.monotonic() + a["budget"]
 
-    def disarm(self) -> None:
+    def disarm(self, levels: int | None = None) -> None:
         with self._cv:
             # _fire consumes _armed before sleeping out the grace; a
             # level that then finishes must still record its wall time
@@ -249,7 +258,18 @@ class Watchdog:
             self._fired_ctx = None
             self._last_release = time.monotonic()
             if a is not None:
-                self._hist.append(time.monotonic() - a["started"])
+                # record PER-LEVEL wall time: a span-N window's elapsed
+                # divides by the levels it actually covered so the next
+                # adaptive budget is level-normalized regardless of
+                # window kind.  ``levels`` lets a stopped superstep
+                # report its committed count — dividing a one-level
+                # window's elapsed by the full declared span would
+                # deflate the history and false-trip the level's own
+                # per-level replay (span > mult makes budget < elapsed)
+                span = max(1, int(a.get("span", 1)))
+                if levels is not None:
+                    span = min(span, max(1, int(levels)))
+                self._hist.append((time.monotonic() - a["started"]) / span)
                 del self._hist[:-3]
 
     def cancel(self) -> None:

@@ -67,6 +67,7 @@ from ..analysis import sanitize as graft_sanitize
 from ..config import RaftConfig
 from ..engine import forecast
 from ..engine import megakernel as graft_megakernel
+from ..engine import superstep as graft_superstep
 from ..engine.invariants import resolve_invariant_kernel
 from ..models.raft import RaftState, init_batch
 from ..ops import hashstore
@@ -145,6 +146,15 @@ class BucketPrograms:
         # a bucket level becomes one dispatch + one fused fetch
         self.fused = jax.jit(
             self._fused_level, static_argnames=("g_cap",)
+        )
+        # multi-level resident superstep (the service slice of
+        # engine/superstep.py): up to N whole bucket levels inside one
+        # lax.while_loop around the fused level body, per-config
+        # retirement (depth caps, aborts, fixpoints) tracked ON DEVICE
+        # and per-level ledgers spooled into a ring — small configs
+        # retire whole jobs in one or two dispatches
+        self.sstep = jax.jit(
+            self._superstep, static_argnames=("g_cap", "span", "ring")
         )
         self.inv_ok = jax.jit(self._inv_ok)
         # shape keys seen by the jitted entry points — the honest
@@ -255,6 +265,138 @@ class BucketPrograms:
         return (slab2, children, bad, rows, fresh, fps, gen_c, new_c,
                 abort_c, ovf, n_g > g_cap, n_g)
 
+    def _superstep(self, st, live, crow, mr_row, salt_row, slab,
+                   done_c, depth_c, cap_c, g_cap: int, span: int,
+                   ring: int):
+        """Up to ``span`` whole bucket levels as ONE device program:
+        a ``lax.while_loop`` around ``_fused_level`` with per-config
+        retirement resident on device — depth caps retire members at
+        the top of each level (the engine's break-BEFORE-expanding
+        order), aborts and fixpoints retire them at the bottom — and
+        each level's per-config ledgers (new/gen/abort counts, the
+        inserted-fps ring for the slab-rebuild source) spooled into
+        preallocated meta arrays the host unpacks from ONE fetch.
+
+        Commit discipline mirrors engine/superstep.py: a level commits
+        only when fully clean (no slab overflow, no g_cap overflow, no
+        invariant violation anywhere in the bucket, ring fits);
+        anything else stops the loop uncommitted and the host replays
+        that level through the per-level fused path.  ``cap_c`` holds
+        per-config depth caps (-1 = none).  Returns the carried state
+        (next frontier/live/crow at ``g_cap``, slab, done, depth), the
+        control scalars (levels committed, reason, ring offset) and
+        the per-level meta + ring arrays."""
+        K = self.K
+        C = self.C
+        B = live.shape[0]
+        R = ring
+        RUN = graft_superstep.REASON_RUN
+        STOP = graft_superstep.REASON_STOP
+        RING = graft_superstep.REASON_RING
+        FIX = graft_superstep.REASON_FIX
+        if B < g_cap:
+            # seat the input batch in the span-wide frontier buffer
+            # (dead rows: live is False there, crow 0 — the staged
+            # path's zero-fill convention)
+            st = jax.tree.map(
+                lambda x: jnp.concatenate(
+                    [x, jnp.zeros((g_cap - B,) + x.shape[1:], x.dtype)]
+                ),
+                st,
+            )
+            live = jnp.concatenate(
+                [live, jnp.zeros((g_cap - B,), bool)]
+            )
+            crow = jnp.concatenate(
+                [crow, jnp.zeros((g_cap - B,), crow.dtype)]
+            )
+
+        def cond(c):
+            lvl, reason = c[0], c[2]
+            return (reason == RUN) & (lvl < span)
+
+        def body(c):
+            (lvl, off, _reason, st, live, crow, slab, done, depth,
+             rf, m_new, m_gen, m_abort, m_ins, m_ng) = c
+            # top-of-level: depth-cap retirement (BEFORE expanding)
+            capped = (cap_c >= 0) & (depth >= cap_c) & ~done
+            done1 = done | capped
+            live1 = live & ~done1[crow]
+            (slab2, children, bad, rows, fresh, fps, gen_c, new_c,
+             abort_c, ovf, ovfg, n_g) = self._fused_level(
+                st, live1, crow, mr_row, salt_row, slab, done1,
+                g_cap=g_cap,
+            )
+            n_ins = fresh.sum().astype(I64)
+            ring_ovf = off + n_ins > R
+            stop = ovf | ovfg | bad.any()
+            commit = (~stop) & (~ring_ovf)
+            # ring append of the inserted (salted) fps, lane-ascending
+            # — the same order the host's np.nonzero selection pins
+            dest = jnp.cumsum(fresh) - 1
+            tgt = jnp.where(fresh, off + dest, jnp.asarray(R, I64))
+            rf = rf.at[tgt].set(fps, mode="drop")
+            m_new = jax.lax.dynamic_update_slice(
+                m_new, new_c[None, :], (lvl, jnp.zeros((), I32))
+            )
+            m_gen = jax.lax.dynamic_update_slice(
+                m_gen, gen_c[None, :], (lvl, jnp.zeros((), I32))
+            )
+            m_abort = jax.lax.dynamic_update_slice(
+                m_abort, abort_c[None, :], (lvl, jnp.zeros((), I32))
+            )
+            m_ins = m_ins.at[lvl].set(n_ins)
+            m_ng = m_ng.at[lvl].set(n_g)
+            # bottom-of-level retirement: aborts, then fixpoints
+            alive = ~done1
+            done2 = (done1 | (alive & abort_c)
+                     | (alive & ~abort_c & (new_c == 0)))
+            depth2 = depth + (
+                alive & ~abort_c & (new_c > 0)
+            ).astype(I64)
+            live_new = jnp.arange(g_cap, dtype=I64) < n_g
+            crow_new = jnp.where(live_new, crow[rows], 0)
+            ended = done2.all() | (n_g == 0)
+            fix = commit & ended
+            reason2 = jnp.where(
+                stop, STOP,
+                jnp.where(ring_ovf, RING, jnp.where(fix, FIX, RUN)),
+            ).astype(I32)
+            sel = lambda a, b: jnp.where(commit, a, b)  # noqa: E731
+            return (
+                lvl + commit.astype(I32),
+                off + jnp.where(commit, n_ins, 0),
+                reason2,
+                jax.tree.map(sel, children, st),
+                sel(live_new, live),
+                sel(crow_new, crow),
+                sel(slab2, slab),
+                sel(done2, done),
+                sel(depth2, depth),
+                rf, m_new, m_gen, m_abort, m_ins, m_ng,
+            )
+
+        init = (
+            jnp.zeros((), I32),
+            jnp.zeros((), I64),
+            jnp.full((), RUN, I32),
+            st, live, crow.astype(I64), slab,
+            done_c, depth_c,
+            jnp.full((R,), jnp.uint64(SENT), jnp.uint64),
+            jnp.zeros((span, self.C), I64),
+            jnp.zeros((span, self.C), I64),
+            jnp.zeros((span, self.C), bool),
+            jnp.zeros((span,), I64),
+            jnp.zeros((span,), I64),
+        )
+        (lvl, off, reason, st, live, crow, slab, done, depth, rf,
+         m_new, m_gen, m_abort, m_ins, m_ng) = jax.lax.while_loop(
+            cond, body, init
+        )
+        ctrl = jnp.stack([lvl.astype(I64), reason.astype(I64), off])
+        return (st, live, crow, slab, done, depth, ctrl, m_new, m_gen,
+                m_abort, m_ins, m_ng, rf)
+
     # -- cold-path helpers -------------------------------------------------
 
     def bad_invariant_name(self, children: RaftState, idx: int) -> str:
@@ -299,6 +441,7 @@ class BatchedChecker:
         max_depths: list[int | None] | None = None,
         use_mxu: bool | None = None,
         megakernel: bool | None = None,
+        superstep: int | None = None,
         progress=None,
     ):
         if not cfgs:
@@ -321,6 +464,13 @@ class BatchedChecker:
         if megakernel is None:
             megakernel = graft_megakernel.enabled_by_env()
         self.megakernel = bool(megakernel)
+        # multi-level bucket supersteps ride the engine's span lever
+        # (TLA_RAFT_SUPERSTEP / --superstep); need the fused path
+        if superstep is None:
+            superstep = graft_superstep.span_from_env()
+        self.superstep_span = (
+            max(1, int(superstep)) if self.megakernel else 1
+        )
         self.C_pad = max(2, forecast.pow2ceil(self.C))
         self.progs = _get_programs(self.kcfg, bool(use_mxu), self.C_pad)
         self.kern = self.progs.kern
@@ -348,7 +498,10 @@ class BatchedChecker:
             mxu=self.use_mxu,
         )
         # stats for the bench record
-        self.stats = dict(levels=0, dispatches=0, programs=0, redos=0)
+        self.stats = dict(
+            levels=0, dispatches=0, programs=0, redos=0,
+            supersteps=0, superstep_levels=0, slab_presizes=0,
+        )
 
     # -- slab management ---------------------------------------------------
 
@@ -507,6 +660,7 @@ class BatchedChecker:
                 depth=int(depth[c]),
                 level_sizes=[int(x) for x in level_sizes[c]],
                 mxu=self.use_mxu,
+                superstep=self.superstep_span,
                 seconds=round(time.monotonic() - t0, 3),
                 violation=kind,
                 batched=True,
@@ -580,6 +734,15 @@ class BatchedChecker:
         # program per magnitude, never a shrink retrace)
         last_n_g = 8  # previous level's survivor count: the fused
         # path's pre-dispatch g_cap signal before the forecast warms
+        # per-config depth caps as a device vector (-1 = fixpoint run)
+        cap_pad = np.asarray(
+            [-1 if d is None else int(d) for d in self.max_depths]
+            + [-1] * (C_pad - C),
+            np.int64,
+        )
+        # a stopped superstep (uncommitted overflow/violation level)
+        # routes that level through the per-level path exactly once
+        skip_ss = False
 
         # ---- level loop --------------------------------------------------
         while True:
@@ -602,6 +765,150 @@ class BatchedChecker:
             B = int(live_h.shape[0])
             live = jnp.asarray(live_h)
             crow = jnp.asarray(crow_h)
+            # ---- multi-level superstep: up to N bucket levels in ONE
+            # program + ONE fetch (engine/superstep.py, service slice).
+            # Per-config retirement runs resident; the per-level
+            # ledgers replay below in exactly the staged order --------
+            if self.megakernel and self.superstep_span > 1 and not skip_ss:
+                span = self.superstep_span
+                g_cap = max(g_floor, forecast.pow2ceil(last_n_g), B)
+                if len(level_totals) > forecast.MIN_LEVELS:
+                    peak = forecast.forecast_peak_new(level_totals, None)
+                    peak = min(
+                        max(peak, 1), 4 * max(last_n_g, 8), 1 << 20
+                    )
+                    g_cap = max(g_cap, forecast.pow2ceil(peak))
+                ring = forecast.pow2ceil(2 * span * g_cap)
+                # presize the slab for the WHOLE span's inserts (the
+                # engine path's hstore.reserve()): a mid-span probe-
+                # window fill stops the window uncommitted and replays
+                # per-level, so every slab growth step would otherwise
+                # cost one wasted span-N dispatch — eroding the 1/N
+                # amortization on exactly the growing levels that need
+                # it.  Same content, bigger capacity: dedup semantics
+                # and per-config counts are unchanged.
+                n_led = sum(len(a) for a in all_fps)
+                need = hashstore.slab_rows(n_led + span * g_cap, 0.25)
+                if need > int(slab.shape[0]):
+                    self.stats["slab_presizes"] += 1
+                    slab, _cap = self._rebuild_slab(all_fps, need)
+                done_pad = np.concatenate(
+                    [done, np.ones(C_pad - C, bool)]
+                )
+                depth_pad = np.concatenate(
+                    [depth, np.zeros(C_pad - C, np.int64)]
+                )
+                progs.note_shapes(
+                    "sstep", B, int(slab.shape[0]), g_cap, span, ring
+                )
+                graft_sanitize.superstep_begin()
+                (st2, live2_d, crow2_d, slab2, done2_d, depth2_d,
+                 ctrl_d, mnew_d, mgen_d, mabort_d, mins_d, mng_d,
+                 rf_d) = progs.sstep(
+                    st, live, crow, mr_dev, salt_dev, slab,
+                    jnp.asarray(done_pad), jnp.asarray(depth_pad),
+                    jnp.asarray(cap_pad),
+                    g_cap=g_cap, span=span, ring=ring,
+                )
+                self.stats["dispatches"] += 1
+                graft_sanitize.note_dispatch("service.superstep")
+                (ctrl, m_new, m_gen, m_abort, m_ins, m_ng, rf_h,
+                 live2, crow2) = jax.device_get((
+                    ctrl_d, mnew_d, mgen_d, mabort_d, mins_d, mng_d,
+                    rf_d, live2_d, crow2_d,
+                ))
+                levels_done = int(ctrl[0])
+                reason = graft_superstep.REASON_NAMES.get(
+                    int(ctrl[1]), "stop"
+                )
+                graft_sanitize.superstep_tick(levels_done)
+                self.stats["supersteps"] += 1
+                self.stats["superstep_levels"] += levels_done
+                self.stats["levels"] += levels_done
+                lvl_before = lvl
+                off = 0
+                for i in range(levels_done):
+                    # replay one committed level's bookkeeping in the
+                    # staged order: depth-cap retirement, aborts, gen,
+                    # fps ledger, fixpoints/level_sizes, totals
+                    for c in range(C):
+                        if (
+                            not done[c]
+                            and self.max_depths[c] is not None
+                            and depth[c] >= self.max_depths[c]
+                        ):
+                            finish(c, True)
+                    active = ~done
+                    for c in range(C):
+                        if active[c] and bool(m_abort[i][c]):
+                            finish(
+                                c, False,
+                                'Assert "split brain" (Raft.tla:185)',
+                            )
+                    for c in range(C):
+                        if not done[c]:
+                            gen[c] += int(m_gen[i][c])
+                    n_ins = int(m_ins[i])
+                    if n_ins:
+                        all_fps.append(
+                            np.asarray(
+                                rf_h[off:off + n_ins], np.uint64
+                            )
+                        )
+                    off += n_ins
+                    for c in range(C):
+                        if done[c]:
+                            continue
+                        n_new = int(m_new[i][c])
+                        if n_new == 0:
+                            finish(c, True)
+                        else:
+                            level_sizes[c].append(n_new)
+                            depth[c] += 1
+                    level_totals.append(
+                        int(sum(int(x) for x in m_new[i][:C]))
+                    )
+                    last_n_g = int(m_ng[i])
+                    lvl += 1
+                    if self.progress is not None:
+                        self.progress(
+                            dict(
+                                level=lvl,
+                                frontier=last_n_g,
+                                configs_alive=int((~done).sum()),
+                                distinct=int(
+                                    sum(sum(ls) for ls in level_sizes)
+                                ),
+                                generated=int(gen.sum()),
+                                elapsed=time.monotonic() - t0,
+                            )
+                        )
+                g_floor = max(g_floor, g_cap)
+                st = st2
+                slab = slab2
+                live_h = np.asarray(live2, bool)
+                crow_h = np.asarray(crow2, np.int64)
+                if reason == "stop" or (
+                    reason == "ring" and levels_done == 0
+                ):
+                    skip_ss = True
+                if checkpoint_dir and lvl > lvl_before:
+                    n_led = sum(len(a) for a in all_fps)
+                    every = 1 if 8 * n_led <= (1 << 24) else 8
+                    if (lvl // every) > (lvl_before // every):
+                        st_np = {
+                            f: np.asarray(
+                                jax.device_get(getattr(st, f))
+                            )
+                            for f in _STATE_FIELDS
+                        }
+                        self._save_bstate(
+                            checkpoint_dir, lvl, st_np, live_h,
+                            crow_h, all_fps, gen, depth, level_sizes,
+                            done, results,
+                        )
+                continue
+            skip_ss = False
             children = bad_h = rows_h = n_g_dev = None
             if self.megakernel:
                 # ---- fused bucket level: ONE program + ONE fetch ----
