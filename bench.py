@@ -43,7 +43,9 @@ land in the record either way), BENCH_SUPERSTEP (0 = per-level fused
 A/B vs the multi-level resident superstep driver; levels_per_dispatch
 lands in the record either way), BENCH_AUDIT (1 = integrity audit at
 BENCH_AUDIT_N rows/level, default 64 — overhead A/B, single-device
-arm), BENCH_SERVICE (1 = the sweep-service
+arm), BENCH_TELEMETRY (0 = flight recorder off — the telemetry
+overhead A/B; on, the record's level accounting comes from the hub),
+BENCH_SERVICE (1 = the sweep-service
 jobs/hour A/B on the synthetic queue instead — see _bench_service).
 """
 
@@ -598,6 +600,13 @@ def main():
         use_superstep = (
             None if ss_env is None or int(ss_env) else 1
         )
+        # BENCH_TELEMETRY=0 disables the run flight recorder — the
+        # overhead A/B lever for the telemetry hub (docs/
+        # OBSERVABILITY.md; target <= 2% wall at depth 12).  With the
+        # hub on, level_seconds/dispatches_per_level in the record are
+        # sourced FROM the hub (one bookkeeping) instead of bench-local
+        # timestamp math; counts are bit-identical either way.
+        use_tel = bool(int(os.environ.get("BENCH_TELEMETRY", "1")))
         # BENCH_AUDIT=1 arms the end-to-end integrity audit at
         # BENCH_AUDIT_N rows/level (default 64) — the A/B lever for the
         # audit-mode overhead record (docs/ROBUSTNESS.md; target < 5%
@@ -644,9 +653,18 @@ def main():
             # choke-point accounting): the megakernel A/B record reports
             # dispatches/level in both arms
             from tla_raft_tpu.analysis import sanitize as _san
+            from tla_raft_tpu.obs import telemetry as _tel
 
             dlog = _san.DispatchLog()
             _san.set_dispatch_sink(dlog)
+            hub = None
+            if use_tel:
+                # in-memory flight recorder (no run dir): the hub's
+                # aggregates are the record's level accounting source
+                hub = _tel.TelemetryHub()
+                _tel.install(hub)
+                _san.obs_watch_compiles()
+                _tel.run_begin(config=cfg.describe(), bench=True)
             try:
                 chk1 = JaxChecker(
                     cfg, chunk=chunk, progress=progress,
@@ -658,6 +676,8 @@ def main():
                 res = chk1.run(max_depth=max_depth)
             finally:
                 _san.set_dispatch_sink(None)
+                if hub is not None:
+                    _tel.install(None)
             dlog.close()
             pipe_on, pipe_win = chk1.pipeline, chk1.pipeline_window
     except Exception as e:
@@ -778,12 +798,21 @@ def main():
     if not mesh_n:
         # per-level wall clock + program dispatches (the fused-vs-
         # staged A/B's secondary metric: launches/level is exactly
-        # what the megakernel removes)
-        out["level_seconds"] = [
-            round(levels[i][2] - (levels[i - 1][2] if i else 0.0), 4)
-            for i in range(len(levels))
-        ]
-        out["dispatches_per_level"] = list(dlog.per_level)
+        # what the megakernel removes).  With the telemetry hub on
+        # (BENCH_TELEMETRY=1, default) both come from the hub's
+        # unified accounting; the bench-local fallback keeps the
+        # BENCH_TELEMETRY=0 arm honest.
+        snap = hub.snapshot() if hub is not None else None
+        out["telemetry"] = bool(hub is not None)
+        if snap is not None and snap["levels"]:
+            out["level_seconds"] = snap["level_seconds"]
+            out["dispatches_per_level"] = snap["dispatches_per_level"]
+        else:
+            out["level_seconds"] = [
+                round(levels[i][2] - (levels[i - 1][2] if i else 0.0), 4)
+                for i in range(len(levels))
+            ]
+            out["dispatches_per_level"] = list(dlog.per_level)
         out["steady_max_dispatches_per_level"] = dlog.steady_max()
         # dispatch amortization: BFS levels retired per engine program
         # dispatch (the superstep's headline metric — 1/span in steady
@@ -843,7 +872,7 @@ def main():
             "audit": out["audit"],
         }
         for k in ("mesh", "mesh_deep", "peak_dev_rows", "exchange",
-                  "level_seconds", "dispatches_per_level",
+                  "telemetry", "level_seconds", "dispatches_per_level",
                   "steady_max_dispatches_per_level",
                   "levels_per_dispatch"):
             if k in out:

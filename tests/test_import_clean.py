@@ -29,6 +29,11 @@ MODULES = [
     "tla_raft_tpu.service.bucket",
     "tla_raft_tpu.service.queue",
     "tla_raft_tpu.service.daemon",
+    "tla_raft_tpu.obs",
+    "tla_raft_tpu.obs.telemetry",
+    "tla_raft_tpu.obs.tracefile",
+    "tla_raft_tpu.obs.progress",
+    "tla_raft_tpu.obs.metrics",
 ]
 
 

@@ -46,6 +46,10 @@ RULES = {
     "GL009": "raw-checkpoint-write: np.savez/os.replace outside "
              "resilience/ — checkpoint artifacts must commit through "
              "resilience.commit_npz",
+    "GL012": "obs-host-purity: telemetry code (tla_raft_tpu/obs/) "
+             "must stay host-side — no jax import, no device "
+             "sync/dispatch (telemetry observes the run, it never "
+             "participates in it)",
 }
 
 # GL006 applies only to the hot level-loop modules (the ~140-site sync
@@ -552,6 +556,46 @@ class _Linter:
                     "this rename is not a checkpoint commit",
                 )
 
+    def gl012_obs_host_purity(self):
+        # the telemetry subsystem's load-bearing contract: obs/ code
+        # runs inside every level loop and from watchdog/writer
+        # threads, so a jax import or device sync there would (a) add
+        # dispatches the GL011 budgets pin and (b) stall the dispatch
+        # pipeline from a hook site.  Banned: importing jax (even
+        # lazily — host purity is not a warm-up property), and any
+        # device-sync attribute call (device_get/device_put/
+        # block_until_ready).
+        rel = self.relpath
+        if not rel.startswith("tla_raft_tpu/obs/"):
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax" or a.name.startswith("jax."):
+                        self.add(
+                            "GL012", node,
+                            f"`import {a.name}` in obs/ — telemetry "
+                            "must stay host-pure (no jax, even "
+                            "lazily); publish from the instrumented "
+                            "module instead",
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "jax" or mod.startswith("jax."):
+                    self.add(
+                        "GL012", node,
+                        f"`from {mod} import ...` in obs/ — telemetry "
+                        "must stay host-pure (no jax, even lazily)",
+                    )
+            elif isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d and d.split(".")[-1] in _SYNC_ATTRS:
+                    self.add(
+                        "GL012", node,
+                        f"`{d}(...)` in obs/ — telemetry code must "
+                        "never sync with or dispatch to a device",
+                    )
+
     # -- driver ----------------------------------------------------------
 
     def run(self, select: set[str] | None = None) -> list[Finding]:
@@ -566,6 +610,7 @@ class _Linter:
             "GL007": self.gl007_worker_device_dispatch,
             "GL008": self.gl008_unused_import,
             "GL009": self.gl009_raw_checkpoint_write,
+            "GL012": self.gl012_obs_host_purity,
         }
         for rule, fn in rules.items():
             if select is None or rule in select:

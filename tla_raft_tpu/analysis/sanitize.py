@@ -35,6 +35,12 @@ from __future__ import annotations
 import os
 import threading
 
+# telemetry hub (obs/telemetry.py, stdlib-only): the dispatch /
+# superstep / shape hooks below are ALREADY the choke points every
+# level loop calls, so the flight recorder publishes from here instead
+# of adding a second set of call sites to the engines
+from ..obs import telemetry as _obs
+
 _tl = threading.local()
 
 # the active sanitizer (None = every hook below is a cheap no-op)
@@ -167,6 +173,7 @@ def note_dispatch(tag: str) -> None:
         CURRENT.note_dispatch(tag)
     if _DISPATCH_SINK is not None:
         _DISPATCH_SINK.note(tag)
+    _obs.dispatch(tag)
 
 
 def superstep_begin() -> None:
@@ -175,6 +182,7 @@ def superstep_begin() -> None:
         CURRENT.superstep_begin()
     if _DISPATCH_SINK is not None:
         _DISPATCH_SINK.superstep_begin()
+    _obs.superstep_begin()
 
 
 def superstep_tick(levels: int) -> None:
@@ -186,6 +194,7 @@ def superstep_tick(levels: int) -> None:
         CURRENT.superstep_tick(levels)
     if _DISPATCH_SINK is not None:
         _DISPATCH_SINK.superstep_tick(levels)
+    _obs.superstep_commit(levels)
 
 
 def note_async_fetch_start() -> None:
@@ -215,6 +224,38 @@ def note_shape_event(reason: str) -> None:
     presize, a new program shape) for the level in flight."""
     if CURRENT is not None:
         CURRENT.note_shape_event(reason)
+    _obs.shape(reason)
+
+
+_OBS_COMPILE_ARMED = False
+
+
+def obs_watch_compiles() -> None:
+    """Publish XLA backend compiles into the telemetry hub.
+
+    Registered ONCE per process (idempotent), independent of the full
+    Sanitizer: the listener is a cheap no-op while no hub is
+    installed, and the prewarm thread's declared marker tags its
+    compiles so the timeline can tell background AOT work from a
+    silent in-line retrace.  Lazy jax import — the device-free module
+    import contract (GL001) holds, and callers arm this only after
+    ``platform.setup_jax``."""
+    global _OBS_COMPILE_ARMED
+    if _OBS_COMPILE_ARMED:
+        return
+    from jax._src import monitoring
+
+    def on_event(name, *a, **kw):
+        if name == "/jax/core/compile/backend_compile_duration":
+            secs = a[0] if a and isinstance(a[0], (int, float)) else (
+                kw.get("duration_secs", 0.0)
+            )
+            _obs.compile_done(
+                float(secs or 0.0), thread_compiles_declared()
+            )
+
+    monitoring.register_event_duration_secs_listener(on_event)
+    _OBS_COMPILE_ARMED = True
 
 
 _UNSET = object()

@@ -203,7 +203,8 @@ class _Silent:
         pass
 
 
-def summarize(res, chk=None, seconds: float | None = None) -> dict:
+def summarize(res, chk=None, seconds: float | None = None,
+              hub=None) -> dict:
     """CheckResult -> the canonical ``--json`` summary schema.
 
     The one place the schema is defined: the CLI's ``--json`` line, the
@@ -211,7 +212,11 @@ def summarize(res, chk=None, seconds: float | None = None) -> dict:
     :func:`run_check` return value all come from here, so they can
     never drift apart.  Keys beginning with ``_`` carry non-JSON
     payloads (the raw result/checker objects) and are stripped by
-    :func:`summary_public` before anything is serialized.
+    :func:`summary_public` before anything is serialized.  ``hub`` (a
+    telemetry hub, when the run carried one) contributes the unified
+    ``telemetry`` block — level wall times, dispatches, fetch waits,
+    grow/redo counts, checkpoint I/O, straggler skew — in ONE place
+    instead of per-subsystem ad-hoc keys.
     """
     out = dict(
         ok=res.ok,
@@ -231,10 +236,16 @@ def summarize(res, chk=None, seconds: float | None = None) -> dict:
     aud = getattr(chk, "audit_stats", None)
     if aud and aud.get("levels"):
         out["audit"] = dict(aud)
-    # per-owner straggler/skew metrics (mesh runs)
+    # per-owner straggler/skew metrics (mesh runs); kept at top level
+    # for compatibility AND folded into the telemetry block below
     skew = getattr(chk, "skew", None)
     if skew is not None and getattr(skew, "levels", 0):
         out["straggler"] = skew.summary()
+    if hub is not None:
+        tel = hub.snapshot()
+        if "straggler" in out:
+            tel["straggler"] = out["straggler"]
+        out["telemetry"] = tel
     return out
 
 
@@ -271,6 +282,7 @@ def run_check(
     audit: int = 0,
     audit_retries: int = 3,
     watchdog: float = 0.0,
+    telemetry: bool | None = None,
     progress=None,
     out=None,
     install_signals: bool = False,
@@ -285,10 +297,99 @@ def run_check(
     Raises ``resilience.Preempted`` on cooperative preemption (the CLI
     maps it to exit 75) and propagates engine errors as exceptions —
     policy (exit codes, tee logs, trace pretty-printing) stays with the
-    caller.  Extra ``_res`` / ``_chk`` / ``_sanitizer`` keys carry the
-    raw objects for callers that need the violation trace or the
-    exchange meter; ``summary_public`` strips them.
+    caller.  Extra ``_res`` / ``_chk`` / ``_sanitizer`` / ``_hub`` keys
+    carry the raw objects for callers that need the violation trace,
+    the exchange meter or the telemetry hub; ``summary_public`` strips
+    them.
+
+    ``telemetry`` (default: ``TLA_RAFT_TELEMETRY``, on) installs the
+    process-wide flight recorder (obs/telemetry.py) for the run: every
+    level, dispatch, ledgered fetch, compile, checkpoint commit,
+    grow/redo and watchdog event lands in ``<checkpoint_dir>/
+    events.jsonl`` (in-memory aggregation only when the run has no
+    checkpoint dir and ``TLA_RAFT_TELEMETRY_DIR`` is unset), and the
+    returned summary carries the unified ``telemetry`` block.  A hub
+    already installed by an outer caller (bench, the service bucket
+    loop) is reused, never re-anchored or closed.
     """
+    from .obs import telemetry as obs_telemetry
+
+    tel_on = (
+        obs_telemetry.enabled_by_env() if telemetry is None
+        else bool(telemetry)
+    ) and backend != "oracle"
+    hub = None
+    own_hub = False
+    if tel_on:
+        hub = obs_telemetry.current()
+        if hub is None:
+            run_dir = (
+                checkpoint_dir
+                or os.environ.get("TLA_RAFT_TELEMETRY_DIR")
+                or None
+            )
+            hub = obs_telemetry.TelemetryHub(run_dir=run_dir)
+            obs_telemetry.install(hub)
+            own_hub = True
+            obs_telemetry.run_begin(
+                config=cfg.describe(), backend=backend, mesh=mesh,
+                mesh_deep=mesh_deep, recover=bool(recover),
+            )
+    try:
+        return _run_check_impl(
+            cfg, backend=backend, max_depth=max_depth, chunk=chunk,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every, recover=recover,
+            fpstore_dir=fpstore_dir, mesh=mesh, exchange=exchange,
+            mesh_deep=mesh_deep, seg_rows=seg_rows, sieve=sieve,
+            compress=compress, cap_x=cap_x, canon=canon,
+            use_hashstore=use_hashstore, pipeline=pipeline,
+            pipeline_window=pipeline_window, prewarm=prewarm,
+            use_mxu=use_mxu, megakernel=megakernel,
+            superstep=superstep, audit=audit,
+            audit_retries=audit_retries, watchdog=watchdog,
+            hub=hub, progress=progress, out=out,
+            install_signals=install_signals,
+        )
+    finally:
+        if own_hub:
+            obs_telemetry.install(None)
+            hub.close()
+
+
+def _run_check_impl(
+    cfg: RaftConfig,
+    *,
+    backend,
+    max_depth,
+    chunk,
+    checkpoint_dir,
+    checkpoint_every,
+    recover,
+    fpstore_dir,
+    mesh,
+    exchange,
+    mesh_deep,
+    seg_rows,
+    sieve,
+    compress,
+    cap_x,
+    canon,
+    use_hashstore,
+    pipeline,
+    pipeline_window,
+    prewarm,
+    use_mxu,
+    megakernel,
+    superstep,
+    audit,
+    audit_retries,
+    watchdog,
+    hub,
+    progress,
+    out,
+    install_signals,
+) -> dict:
     if mesh_deep and not mesh:
         raise ValueError("mesh_deep requires mesh >= 1")
     if mesh_deep and not fpstore_dir:
@@ -306,6 +407,13 @@ def run_check(
         from .platform import setup_jax
 
         jax = setup_jax()
+        if hub is not None:
+            # publish XLA backend compiles into the flight recorder
+            # (idempotent, armed only after setup_jax picked the
+            # platform)
+            from .analysis.sanitize import obs_watch_compiles
+
+            obs_watch_compiles()
         if install_signals:
             # SIGTERM/SIGINT request a cooperative preemption: the
             # engine finishes the in-flight level, flushes its
@@ -466,10 +574,18 @@ def run_check(
                 finally:
                     wd_teardown()
 
-    summary = summarize(res, chk, time.monotonic() - t0)
+    if hub is not None:
+        from .obs import telemetry as obs_telemetry
+
+        obs_telemetry.run_end(
+            ok=res.ok, distinct=res.distinct,
+            generated=res.generated, depth=res.depth,
+        )
+    summary = summarize(res, chk, time.monotonic() - t0, hub=hub)
     summary["_res"] = res
     summary["_chk"] = chk
     summary["_sanitizer"] = sanitizer
+    summary["_hub"] = hub
     return summary
 
 
@@ -626,6 +742,19 @@ def main(argv=None) -> int:
     p.add_argument("--coverage", action="store_true",
                    help="print per-action fired-transition counts (TLC -coverage)")
     p.add_argument("--json", action="store_true", help="emit a final JSON summary line")
+    p.add_argument("--telemetry", type=int, choices=(0, 1), default=None,
+                   help="run flight recorder (obs/telemetry.py): typed "
+                        "run events appended crash-tolerantly to "
+                        "events.jsonl in the checkpoint dir, plus the "
+                        "unified telemetry block in --json.  Default "
+                        "on; 0 disables.  Host-side only — counts and "
+                        "dispatch/fetch budgets are identical either "
+                        "way.  env: TLA_RAFT_TELEMETRY")
+    p.add_argument("--progress", action="store_true",
+                   help="live one-line progress display (states/s, "
+                        "frontier, slab load, levels/dispatch, "
+                        "forecast ETA to fixpoint) instead of one "
+                        "Progress line per level")
     args = p.parse_args(argv)
 
     if args.supervise:
@@ -686,8 +815,27 @@ def main(argv=None) -> int:
             return 2
         print(f"Spec {spec_path}: structure matches compiled semantics.", file=out)
 
+    pline = None
+    if args.progress:
+        from .obs.progress import ProgressLine
+
+        pline = ProgressLine(stream=sys.stderr)
+
     def progress(s):
         rate = s["distinct"] / max(s["elapsed"], 1e-9)
+        if pline is not None:
+            # live CR-updated line on stderr; the grep-able per-level
+            # Progress lines keep landing in the log file (or on
+            # stdout under --log - , which is a different stream from
+            # the live line — the grep contract survives either way)
+            pline.write(s)
+            print(
+                f"Progress: level {s['level']}, frontier "
+                f"{s['frontier']}, distinct {s['distinct']}, "
+                f"generated {s['generated']}, {rate:,.0f} states/s",
+                file=logf if logf else out,
+            )
+            return
         print(
             f"Progress: level {s['level']}, frontier {s['frontier']}, "
             f"distinct {s['distinct']}, generated {s['generated']}, "
@@ -739,6 +887,9 @@ def main(argv=None) -> int:
             audit=args.audit,
             audit_retries=args.audit_retries,
             watchdog=args.watchdog,
+            telemetry=(
+                None if args.telemetry is None else bool(args.telemetry)
+            ),
             progress=progress,
             out=out,
             install_signals=(args.backend != "oracle"),
@@ -779,9 +930,19 @@ def main(argv=None) -> int:
     res = summary["_res"]
     chk = summary["_chk"]
     sanitizer = summary["_sanitizer"]
+    hub = summary.get("_hub")
 
+    if pline is not None:
+        pline.done()
     dt = time.monotonic() - t0
     print(file=out)
+    if hub is not None and hub.path:
+        print(
+            f"Telemetry: {hub.n_events} events -> {hub.path} "
+            "(timeline: python -m tla_raft_tpu.obs trace "
+            f"{os.path.dirname(hub.path)})",
+            file=out,
+        )
     if sanitizer is not None:
         sanitizer.print_report(out)
     if res.ok:
@@ -812,9 +973,9 @@ def main(argv=None) -> int:
     print(f"Finished in {dt:.1f}s ({res.distinct / max(dt, 1e-9):,.0f} distinct states/s).", file=out)
     if args.json:
         # the one schema (summarize): ok/distinct/generated/depth/
-        # level_sizes/mxu/seconds/violation — shared with run_check and
-        # the sweep service's result.json records
-        print(json.dumps(summarize(res, chk, dt)), file=out)
+        # level_sizes/mxu/seconds/violation/telemetry — shared with
+        # run_check and the sweep service's result.json records
+        print(json.dumps(summarize(res, chk, dt, hub=hub)), file=out)
     if logf:
         logf.close()
     if res.ok and sanitizer is not None and not sanitizer.ok:

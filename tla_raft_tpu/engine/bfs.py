@@ -67,6 +67,7 @@ import numpy as np
 
 from .. import resilience
 from ..analysis import sanitize as graft_sanitize
+from ..obs import telemetry as graft_obs
 from ..config import RaftConfig
 from ..models.raft import RaftState, init_batch, to_oracle
 from ..ops import hashstore
@@ -1185,6 +1186,8 @@ class JaxChecker:
                     self._degraded_visited = self._degrade_hashstore(e)
                     return dict(degraded=True, parent=frontier)
                 self._mega_stats["redo_slab"] += 1
+                graft_obs.grow("slab", self.hstore.cap)
+                graft_obs.redo("slab")
                 continue
             if ctrl[mk.CTRL_OVF_X]:
                 # a chunk overflowed its compaction budget: the same
@@ -1193,11 +1196,15 @@ class JaxChecker:
                 self.cap_g = max(self.cap_g, self.G * self.cap_x // 2)
                 self._jit_expand_programs()
                 self._mega_stats["redo_x"] += 1
+                graft_obs.grow("cap_x", self.cap_x)
+                graft_obs.redo("cap_x")
                 continue
             if n_new > cap_out:
                 # exact capacity is now known — one redo lands it
                 out_floor = n_new
                 self._mega_stats["redo_out"] += 1
+                graft_obs.grow("cap_out", n_new)
+                graft_obs.redo("cap_out")
                 continue
             if int(ctrl[mk.CTRL_ABORT]) < n_f:
                 break  # violation: counts are final, nothing is adopted
@@ -1219,6 +1226,8 @@ class JaxChecker:
                 # another engine's key must not be retraced through
                 self._jit_expand_programs()
                 self._mega_stats["redo_m"] += 1
+                graft_obs.grow("cap_m", self.cap_m)
+                graft_obs.redo("cap_m")
                 continue
             break
         self._hs_pending = slab2
@@ -3479,6 +3488,7 @@ class JaxChecker:
                 )
             if max_depth is not None and depth >= max_depth:
                 break
+            graft_obs.level_begin(depth + 1, n_f)
             if self.watchdog is not None:
                 # armed BEFORE the device fault sites: an injected hang
                 # at the dispatch site is exactly what it must convert
@@ -3586,6 +3596,14 @@ class JaxChecker:
                     depth += 1
                     trace_levels.append((rec["pidx"], rec["slot"]))
                     n_f = n_new
+                    graft_obs.level_commit(
+                        depth, n_new, distinct, generated,
+                        slab_cap=(
+                            self.hstore.cap
+                            if self.use_hashstore and self.hstore
+                            is not None else 0
+                        ),
+                    )
                     if self.progress is not None:
                         self.progress(
                             dict(
@@ -3666,6 +3684,7 @@ class JaxChecker:
                         )
                         self._jit_expand_programs()
                         self._mega_stats["redo_x"] += 1
+                        graft_obs.grow("cap_x", self.cap_x)
                     if flags & graft_superstep.FLAG_OVF_SLAB:
                         self._hs_pending = None
                         try:
@@ -3676,6 +3695,7 @@ class JaxChecker:
                             visited = self._degrade_hashstore(e)
                         else:
                             self._mega_stats["redo_slab"] += 1
+                            graft_obs.grow("slab", self.hstore.cap)
                     if (flags & graft_superstep.FLAG_OVF_M
                             and self.cap_m < self.kern.uni.M):
                         # mirror the per-level cap_m redo (widen + re-
@@ -3693,6 +3713,7 @@ class JaxChecker:
                         frontier = self._widen_msg_ids(frontier)
                         self._jit_expand_programs()
                         self._mega_stats["redo_m"] += 1
+                        graft_obs.grow("cap_m", self.cap_m)
                 if self.watchdog is not None:
                     # a stopped window's elapsed covered only the
                     # committed levels (+ the aborted attempt): keep
@@ -3741,6 +3762,10 @@ class JaxChecker:
                 # (pure computation, rare).  cap_x is baked into the traced
                 # chunk program, so re-jit; cap_g is a static jit arg and
                 # retraces on its own.
+                graft_obs.redo(
+                    "cap_x" if overflow else
+                    ("cap_g" if overflow_g else "slab")
+                )
                 if overflow_h:
                     # a probe window filled: rehash into a bigger slab and
                     # redo against the ORIGINAL slab (the pending update
@@ -3752,6 +3777,8 @@ class JaxChecker:
                         # any grow failure (device OOM, injected fault)
                         # degrades to the sort path — never mid-run death
                         visited = self._degrade_hashstore(e)
+                    else:
+                        graft_obs.grow("slab", self.hstore.cap)
                 if overflow:
                     # half-step growth ({2^k, 3*2^(k-1)}): a doubled cap_x
                     # inflates every downstream lane count (group filter,
@@ -3761,8 +3788,10 @@ class JaxChecker:
                     self.cap_x = _cap_steps(self.cap_x + 1)
                     self.cap_g = max(self.cap_g, self.G * self.cap_x // 2)
                     self._jit_expand_programs()
+                    graft_obs.grow("cap_x", self.cap_x)
                 if overflow_g:
                     self.cap_g *= 2
+                    graft_obs.grow("cap_g", self.cap_g)
             if abort_at < n_f:
                 # action_counts stays None on violations, like the oracle:
                 # coverage of a partially-expanded level is ill-defined
@@ -3890,6 +3919,8 @@ class JaxChecker:
                         # grow failure degrades to the sort path (the
                         # adopted slab holds the full visited set)
                         visited = self._degrade_hashstore(e)
+                    else:
+                        graft_obs.grow("slab", self.hstore.cap)
             elif self.host_store is None:
                 # merge, then trim the store to a pow4 capacity >= distinct;
                 # new_fps is survivor-compacted, so slicing keeps every
@@ -3913,6 +3944,14 @@ class JaxChecker:
             trace_levels.append((pidx_np, slot_np))
             n_f = n_new
 
+            graft_obs.level_commit(
+                depth, n_new, distinct, generated,
+                slab_cap=(
+                    self.hstore.cap
+                    if self.host_store is None and self.use_hashstore
+                    and self.hstore is not None else 0
+                ),
+            )
             if self.progress is not None:
                 self.progress(
                     dict(
@@ -3990,6 +4029,10 @@ class JaxChecker:
                     parent_prev, frontier, pidx_np, slot_np,
                     level_fps_ref,
                     n_new, depth,
+                )
+                graft_obs.audit(
+                    depth, min(self.audit, n_new),
+                    len(problems or []),
                 )
                 if problems:
                     return self._audit_rewind(

@@ -152,6 +152,12 @@ def level_program_for(eng, donate: bool):
             _PROG_CACHE[key] = (prog, owner)
             return prog
     prog = build_level_program(eng, donate)
+    # flight-recorder breadcrumb: a fresh fused program was built (the
+    # compile itself lands on the compile track when it first runs)
+    from ..obs import telemetry as _obs
+
+    _obs.emit("program", kind="megakernel", chunk=eng.chunk,
+              cap_x=eng.cap_x, cap_m=eng.cap_m)
     _PROG_CACHE[key] = (prog, eng)
     while len(_PROG_CACHE) > _PROG_CACHE_MAX:
         _PROG_CACHE.pop(next(iter(_PROG_CACHE)))

@@ -101,12 +101,24 @@ def fetch(tree):
     wrapper (the transfer ledger) sees every pipeline fetch; with
     ``async_start`` already issued the call returns as soon as the
     in-flight copy lands instead of round-tripping from scratch.
+    Telemetry: every ledgered fetch publishes its measured wait + byte
+    count to the hub (the "fetch window" timeline track).
     """
+    import time as _time
+
     import jax
 
+    from ..obs import telemetry as _obs
+
+    t0 = _time.monotonic() if _obs.current() is not None else 0.0
     # graftlint: waive[GL006] — THE intended sync point of the async
     # pipeline: every window fetch funnels through this one site
-    return jax.device_get(tree)
+    out = jax.device_get(tree)
+    if _obs.current() is not None:
+        _obs.fetch_done(
+            _time.monotonic() - t0, graft_sanitize._nbytes(out)
+        )
+    return out
 
 
 class AsyncFetchWindow:

@@ -145,6 +145,11 @@ def superstep_program_for(eng, span: int, donate: bool):
             _PROG_CACHE[key] = (prog, owner)
             return prog
     prog = build_superstep_program(eng, span, donate)
+    # flight-recorder breadcrumb (see megakernel.level_program_for)
+    from ..obs import telemetry as _obs
+
+    _obs.emit("program", kind="superstep", span=int(span),
+              chunk=eng.chunk, cap_x=eng.cap_x, cap_m=eng.cap_m)
     _PROG_CACHE[key] = (prog, eng)
     while len(_PROG_CACHE) > _PROG_CACHE_MAX:
         _PROG_CACHE.pop(next(iter(_PROG_CACHE)))

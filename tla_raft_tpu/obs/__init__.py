@@ -1,0 +1,15 @@
+"""Unified telemetry subsystem (docs/OBSERVABILITY.md).
+
+* ``telemetry`` — the process-wide event hub + crash-tolerant
+  ``events.jsonl`` flight recorder every level loop publishes into.
+* ``tracefile`` — Chrome trace-event (Perfetto) timeline export.
+* ``progress``  — live progress line + fixpoint ETA forecasting.
+* ``metrics``   — counter/gauge/histogram snapshots for the service.
+
+Host-purity contract (graftlint GL012): nothing under ``obs/`` may
+import jax, sync with a device, or dispatch a program — telemetry
+observes the run, it never participates in it.  Module imports are
+stdlib-only (GL001 device-free import contract).
+"""
+
+from . import metrics, progress, telemetry, tracefile  # noqa: F401

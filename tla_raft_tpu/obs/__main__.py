@@ -1,0 +1,231 @@
+"""CLI: ``python -m tla_raft_tpu.obs`` — telemetry reporting tools.
+
+    python -m tla_raft_tpu.obs report RUN_DIR [BASELINE_RUN_DIR] [--json]
+    python -m tla_raft_tpu.obs trace  RUN_DIR [-o OUT.json]
+    python -m tla_raft_tpu.obs metrics ROOT
+
+``report`` renders a per-level table (wall, new states, dispatches,
+fetch wait, grows) from a run directory's ``events.jsonl``; with a
+second run dir it prints the two runs side by side with per-level and
+total deltas (the overhead/regression A/B view).  ``trace`` exports
+the Chrome trace-event JSON timeline (load it in
+https://ui.perfetto.dev).  ``metrics`` pretty-prints a service root's
+``metrics.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import metrics as obs_metrics
+from . import tracefile
+from .telemetry import EVENTS_NAME, read_events
+
+
+def _events_path(run_dir: str) -> str:
+    return (
+        run_dir if run_dir.endswith(".jsonl")
+        else os.path.join(run_dir, EVENTS_NAME)
+    )
+
+
+def summarize_events(events: list[dict]) -> dict:
+    """Per-level table + run totals from a raw event stream (the
+    post-hoc twin of TelemetryHub.snapshot, for ``report``)."""
+    levels: list[dict] = []
+    cur = dict(dispatches=0, fetches=0, fetch_wait_s=0.0, grows=0,
+               redos=0, checkpoint_s=0.0)
+    boundary = 0.0
+    totals = dict(
+        events=len(events), levels=0, dispatches=0, fetches=0,
+        fetch_wait_s=0.0, compiles=0, compile_s=0.0, checkpoints=0,
+        checkpoint_s=0.0, grows=0, redos=0, supersteps=0,
+        superstep_levels=0, watchdog_trips=0, wall_s=0.0,
+        distinct=0, generated=0,
+    )
+    for doc in events:
+        t = float(doc.get("t", 0.0))
+        k = doc.get("ev")
+        totals["wall_s"] = max(totals["wall_s"], t)
+        if k == "run_begin":
+            boundary = t
+        elif k == "dispatch":
+            cur["dispatches"] += 1
+            totals["dispatches"] += 1
+        elif k == "fetch":
+            cur["fetches"] += 1
+            totals["fetches"] += 1
+            cur["fetch_wait_s"] += float(doc.get("s") or 0.0)
+            totals["fetch_wait_s"] += float(doc.get("s") or 0.0)
+        elif k == "grow":
+            cur["grows"] += 1
+            totals["grows"] += 1
+        elif k == "redo":
+            cur["redos"] += 1
+            totals["redos"] += 1
+        elif k == "compile":
+            totals["compiles"] += 1
+            totals["compile_s"] += float(doc.get("s") or 0.0)
+        elif k == "checkpoint":
+            totals["checkpoints"] += 1
+            cur["checkpoint_s"] += float(doc.get("s") or 0.0)
+            totals["checkpoint_s"] += float(doc.get("s") or 0.0)
+        elif k == "superstep_commit":
+            totals["supersteps"] += 1
+            totals["superstep_levels"] += int(doc.get("levels") or 0)
+        elif k == "watchdog_trip":
+            totals["watchdog_trips"] += 1
+        elif k == "level_commit":
+            levels.append(dict(
+                level=int(doc.get("level") or 0),
+                seconds=round(t - boundary, 4),
+                n_new=int(doc.get("n_new") or 0),
+                **{kk: (round(v, 4) if isinstance(v, float) else v)
+                   for kk, v in cur.items()},
+            ))
+            totals["levels"] += 1
+            totals["distinct"] = int(doc.get("distinct") or 0)
+            totals["generated"] = int(doc.get("generated") or 0)
+            boundary = t
+            cur = dict(dispatches=0, fetches=0, fetch_wait_s=0.0,
+                       grows=0, redos=0, checkpoint_s=0.0)
+    for k in ("fetch_wait_s", "compile_s", "checkpoint_s", "wall_s"):
+        totals[k] = round(totals[k], 4)
+    return dict(levels=levels, totals=totals)
+
+
+def _print_table(tag: str, rep: dict, out) -> None:
+    t = rep["totals"]
+    print(f"== {tag}: {t['levels']} levels, {t['distinct']:,} distinct, "
+          f"wall {t['wall_s']:.2f}s ==", file=out)
+    print(f"{'lvl':>4} {'new':>10} {'sec':>9} {'disp':>5} "
+          f"{'fetch':>5} {'wait_s':>8} {'grow':>4} {'redo':>4}",
+          file=out)
+    for lv in rep["levels"]:
+        print(
+            f"{lv['level']:>4} {lv['n_new']:>10,} {lv['seconds']:>9.3f} "
+            f"{lv['dispatches']:>5} {lv['fetches']:>5} "
+            f"{lv['fetch_wait_s']:>8.3f} {lv['grows']:>4} "
+            f"{lv['redos']:>4}",
+            file=out,
+        )
+    print(
+        f"totals: {t['dispatches']} dispatches "
+        f"({t['levels'] / max(t['dispatches'], 1):.2f} levels/dispatch), "
+        f"{t['fetches']} fetches ({t['fetch_wait_s']:.3f}s wait), "
+        f"{t['compiles']} compiles ({t['compile_s']:.1f}s), "
+        f"{t['checkpoints']} checkpoints ({t['checkpoint_s']:.3f}s), "
+        f"{t['grows']} grows / {t['redos']} redos, "
+        f"{t['supersteps']} supersteps / {t['superstep_levels']} levels",
+        file=out,
+    )
+
+
+def _cmd_report(args) -> int:
+    events, dropped = read_events(_events_path(args.run_dir))
+    if not events:
+        print(f"{args.run_dir}: no readable events", file=sys.stderr)
+        return 2
+    rep = summarize_events(events)
+    if dropped:
+        rep["totals"]["torn_lines"] = dropped
+    if args.baseline:
+        bev, bdropped = read_events(_events_path(args.baseline))
+        if not bev:
+            print(f"{args.baseline}: no readable events",
+                  file=sys.stderr)
+            return 2
+        brep = summarize_events(bev)
+        if args.json:
+            print(json.dumps(dict(run=rep, baseline=brep)))
+            return 0
+        _print_table(args.run_dir, rep, sys.stdout)
+        print()
+        _print_table(args.baseline, brep, sys.stdout)
+        print()
+        aw, bw = rep["totals"]["wall_s"], brep["totals"]["wall_s"]
+        print("== compare (run vs baseline) ==")
+        print(f"wall: {aw:.2f}s vs {bw:.2f}s "
+              f"({100 * (aw - bw) / max(bw, 1e-9):+.2f}%)")
+        n = min(len(rep["levels"]), len(brep["levels"]))
+        for la, lb in zip(rep["levels"][:n], brep["levels"][:n]):
+            ds = la["seconds"] - lb["seconds"]
+            print(
+                f"  level {la['level']:>3}: {la['seconds']:>8.3f}s vs "
+                f"{lb['seconds']:>8.3f}s ({ds:+.3f}s), "
+                f"disp {la['dispatches']} vs {lb['dispatches']}"
+            )
+        return 0
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        _print_table(args.run_dir, rep, sys.stdout)
+        if dropped:
+            print(f"(torn tail: {dropped} undecodable line(s) dropped)")
+    return 0
+
+
+def _cmd_trace(args) -> int:
+    src = _events_path(args.run_dir)
+    out = args.out or os.path.join(
+        args.run_dir if os.path.isdir(args.run_dir)
+        else os.path.dirname(args.run_dir) or ".",
+        "trace.json",
+    )
+    stats = tracefile.export(src, out)
+    if stats["events"] == 0:
+        print(f"{src}: no readable events", file=sys.stderr)
+        return 2
+    print(
+        f"wrote {stats['trace_events']} trace events "
+        f"(from {stats['events']} run events"
+        + (f", {stats['dropped']} torn" if stats["dropped"] else "")
+        + f") to {stats['out']} — load in https://ui.perfetto.dev"
+    )
+    return 0
+
+
+def _cmd_metrics(args) -> int:
+    doc = obs_metrics.load(args.root)
+    if doc is None:
+        print(f"{args.root}: no readable metrics.json", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(doc))
+    else:
+        obs_metrics.render(doc)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tla_raft_tpu.obs")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pr = sub.add_parser("report", help="per-level telemetry table")
+    pr.add_argument("run_dir",
+                    help="run dir holding events.jsonl (or the file)")
+    pr.add_argument("baseline", nargs="?", default=None,
+                    help="second run dir to compare against")
+    pr.add_argument("--json", action="store_true")
+
+    pt = sub.add_parser("trace", help="export Chrome trace JSON")
+    pt.add_argument("run_dir")
+    pt.add_argument("-o", "--out", default=None)
+
+    pm = sub.add_parser("metrics", help="render a service metrics.json")
+    pm.add_argument("root")
+    pm.add_argument("--json", action="store_true")
+
+    args = p.parse_args(argv)
+    if args.cmd == "report":
+        return _cmd_report(args)
+    if args.cmd == "trace":
+        return _cmd_trace(args)
+    return _cmd_metrics(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

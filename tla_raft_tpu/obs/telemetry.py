@@ -1,0 +1,597 @@
+"""Process-wide telemetry hub: the run flight recorder.
+
+Every level loop, the async pipeline, the atomic checkpoint writer,
+the watchdog and the sweep service publish typed, monotonic-timestamped
+run events into one process-global hub (:func:`install` /
+:func:`current`).  The hub
+
+* appends each event crash-tolerantly to ``events.jsonl`` in the run
+  directory — tmp-free ``"a"``-mode appends of self-checking lines
+  (each line carries a CRC of its own payload in ``"d"``), so a torn
+  tail is detected and tolerated on read instead of poisoning the
+  stream (:func:`read_events`), and a resumed run heals the tail
+  before appending (:func:`_heal_tail`);
+* aggregates the per-level accounting (level wall times, dispatches,
+  ledgered fetch waits, grow/redo counts, checkpoint I/O, compiles,
+  superstep amortization) host-side, so ``check.py --json``'s
+  ``telemetry`` block and bench.py read ONE bookkeeping instead of
+  three ad-hoc meters.
+
+Host-purity contract (graftlint GL012): this module — the whole
+``obs/`` package — must never import jax, touch a device array, or
+dispatch a program.  Publishing is a plain function call with a
+``CURRENT is None`` fast path; with telemetry off every hook in the
+tree is one global read + one branch.
+
+Event taxonomy (docs/OBSERVABILITY.md):
+
+================  ======================================================
+``run_begin``     config + engine flags, wall-clock anchor
+``run_end``       verdict, distinct/generated/depth
+``level_begin``   ``level`` (1-based), ``frontier`` rows entering it
+``level_commit``  ``level``, ``n_new``, ``distinct``, ``generated``,
+                  ``slab_cap`` (0 = no device hash slab)
+``superstep_begin/commit``  one multi-level resident dispatch window
+``dispatch``      one device program dispatch (``tag`` = call site)
+``fetch``         one ledgered pipeline fetch: ``s`` wait, ``b`` bytes
+``compile``       one XLA backend compile: ``s``, ``declared`` (prewarm)
+``checkpoint``    one atomic artifact commit: ``kind``, ``name``,
+                  ``s``, ``b``
+``grow``/``redo`` a named capacity budget grew / a level re-ran
+``watchdog_arm``/``watchdog_trip``  hang-watchdog lifecycle
+``audit``         one sampled-recomputation audit: ``rows``,
+                  ``problems``
+``retire``        one bucket member retired (service)
+``exchange``      one mesh level's fingerprint-exchange bytes
+``skew``          per-owner straggler skew of one mesh level
+``shape``         a declared recompile cause (capacity/shape event)
+``integrity``     a conservation/audit fail-stop fired
+================  ======================================================
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+
+# flush the append buffer every N events (level boundaries flush too);
+# small enough that a SIGKILL loses at most a level's tail of events
+FLUSH_EVERY = 64
+
+EVENTS_NAME = "events.jsonl"
+
+CURRENT: "TelemetryHub | None" = None
+
+
+def enabled_by_env() -> bool:
+    """Telemetry default: ON; ``TLA_RAFT_TELEMETRY=0`` disables."""
+    return os.environ.get("TLA_RAFT_TELEMETRY", "1") != "0"
+
+
+def install(hub: "TelemetryHub | None") -> None:
+    """Set the process-global hub (None = every hook is a no-op)."""
+    global CURRENT
+    CURRENT = hub
+
+
+def current() -> "TelemetryHub | None":
+    return CURRENT
+
+
+def _clean(v):
+    """JSON-safe field coercion (numpy scalars arrive from engines)."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    item = getattr(v, "item", None)
+    if item is not None:
+        try:
+            return item()
+        except (TypeError, ValueError):
+            pass
+    if isinstance(v, (list, tuple)):
+        return [_clean(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _clean(x) for k, x in v.items()}
+    return str(v)
+
+
+def _line_digest(core: str) -> str:
+    return format(zlib.crc32(core.encode("utf-8")) & 0xFFFFFFFF, "08x")
+
+
+def encode_event(ev: dict) -> str:
+    """One self-checking JSONL line: payload + CRC of the payload."""
+    core = json.dumps(ev, sort_keys=True, separators=(",", ":"))
+    return json.dumps(
+        dict(ev, d=_line_digest(core)),
+        sort_keys=True, separators=(",", ":"),
+    )
+
+
+def decode_line(line: str) -> dict | None:
+    """Parse + digest-check one line; None = torn/corrupt."""
+    try:
+        doc = json.loads(line)
+    except ValueError:
+        return None
+    if not isinstance(doc, dict):
+        return None
+    d = doc.pop("d", None)
+    core = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    if d != _line_digest(core):
+        return None
+    return doc
+
+
+def read_events(path: str) -> tuple[list[dict], int]:
+    """Read an event stream, tolerating a torn tail.
+
+    Returns ``(events, dropped)``: every digest-verified event up to
+    the first bad line, and the count of lines dropped from there on
+    (0 on a clean file).  Never raises on torn/corrupt content — a
+    crashed writer's half-line is the EXPECTED failure mode.
+    """
+    events: list[dict] = []
+    dropped = 0
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            lines = fh.read().splitlines()
+    except FileNotFoundError:
+        return [], 0
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        doc = decode_line(line)
+        if doc is None:
+            dropped = sum(1 for x in lines[i:] if x.strip())
+            break
+        events.append(doc)
+    return events, dropped
+
+
+def _heal_tail(path: str) -> int:
+    """Truncate a torn tail so a resumed run appends after the last
+    good, newline-terminated line (an unterminated tail is torn even
+    if it happens to parse — appending after it would corrupt the next
+    line).  Returns the number of lines dropped."""
+    if not os.path.exists(path):
+        return 0
+    with open(path, "rb") as fh:
+        data = fh.read()
+    keep = 0  # byte offset after the last good terminated line
+    dropped = 0
+    off, n = 0, len(data)
+    while off < n:
+        nl = data.find(b"\n", off)
+        if nl < 0:
+            if data[off:].strip():
+                dropped += 1
+            break
+        raw = data[off:nl]
+        if raw.strip() and decode_line(
+            raw.decode("utf-8", "replace")
+        ) is None:
+            dropped += sum(
+                1 for x in data[off:].split(b"\n") if x.strip()
+            )
+            break
+        off = nl + 1
+        keep = off
+    if keep < n:
+        with open(path, "r+b") as fh:
+            fh.truncate(keep)
+    return dropped
+
+
+def _last_event_t(path: str, tail_bytes: int = 1 << 16) -> float | None:
+    """Timestamp of the last verified event line (reads only the tail;
+    None on an empty/unreadable stream)."""
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - tail_bytes))
+            chunk = fh.read()
+    except OSError:
+        return None
+    for raw in reversed(chunk.split(b"\n")):
+        if not raw.strip():
+            continue
+        doc = decode_line(raw.decode("utf-8", "replace"))
+        if doc is not None:
+            try:
+                return float(doc.get("t", 0.0))
+            except (TypeError, ValueError):
+                return None
+    return None
+
+
+class TelemetryHub:
+    """One run's flight recorder + host-side aggregate accounting.
+
+    ``run_dir=None`` keeps the stream in memory only (the aggregates —
+    the ``--json`` ``telemetry`` block — still work); with a run dir
+    the stream appends to ``<run_dir>/events.jsonl``.  Usable as a
+    context manager: installs itself as the process hub on enter,
+    uninstalls + flushes on exit.
+    """
+
+    def __init__(self, run_dir: str | None = None,
+                 path: str | None = None):
+        if path is None and run_dir is not None:
+            path = os.path.join(run_dir, EVENTS_NAME)
+        self.path = path
+        self.healed_lines = 0
+        self._fh = None
+        self._buf: list[str] = []
+        # two locks: _lock guards the in-memory buffer + aggregates
+        # (held only for list/dict ops — emit can never block on a
+        # hung filesystem), _io_lock serializes the actual file writes
+        self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self._t0 = time.monotonic()
+        # resumed stream: rebase this run's clock past the existing
+        # stream's last timestamp so the spliced events.jsonl stays
+        # monotonic and the exported trace never overlays the crashed
+        # run with the resumed one.  Healing the torn tail happens NOW
+        # (eagerly) so the rebase reads only verified lines.
+        self._t_off = 0.0
+        if path is not None and os.path.exists(path):
+            self.healed_lines = _heal_tail(path)
+            last = _last_event_t(path)
+            if last is not None:
+                self._t_off = last + 1e-6
+        self.n_events = 0
+        # -- aggregates (the --json telemetry block) ----------------------
+        self.levels = 0
+        self.level_seconds: list[float] = []
+        self.level_new: list[int] = []
+        self.dispatches_per_level: list[int] = []
+        self.fetches_per_level: list[int] = []
+        self.dispatches = 0
+        self.fetches = 0
+        self.fetch_wait_s = 0.0
+        self.fetch_bytes = 0
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.prewarm_compiles = 0
+        self.checkpoints = 0
+        self.checkpoint_s = 0.0
+        self.checkpoint_bytes = 0
+        self.grows: dict[str, int] = {}
+        self.redos = 0
+        self.supersteps = 0
+        self.superstep_levels = 0
+        self.watchdog_armed = 0
+        self.watchdog_trips = 0
+        self.audit_levels = 0
+        self.audit_rows = 0
+        self.audit_problems = 0
+        self.retired = 0
+        self.exchange_bytes = 0
+        self.exchange_raw_bytes = 0
+        self.integrity_failures = 0
+        self.slab_cap = 0
+        self.distinct = 0
+        self._last_boundary = self._t_off
+        self._lvl_dispatches = 0
+        self._lvl_fetches = 0
+
+    # -- lifecycle --------------------------------------------------------
+
+    def __enter__(self):
+        install(self)
+        return self
+
+    def __exit__(self, *exc):
+        install(None)
+        self.close()
+        return False
+
+    def _open(self):
+        if self._fh is None and self.path is not None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            # append-mode flight recorder: the torn-tail heal already
+            # ran at construction, so this append lands cleanly
+            self._fh = open(self.path, "a", encoding="utf-8")
+        return self._fh
+
+    def flush(self) -> None:
+        if self.path is None:
+            return
+        with self._lock:
+            buf, self._buf = self._buf, []
+        if not buf:
+            return
+        with self._io_lock:
+            fh = self._open()
+            fh.write("".join(buf))
+            fh.flush()
+
+    def flush_best_effort(self, timeout: float = 2.0) -> None:
+        """Bounded-time flush for paths that must never block (the
+        watchdog's hard-exit ladder): the write runs on a daemon side
+        thread and is abandoned after ``timeout`` — a hung filesystem
+        must not wedge the thread whose whole job is converting hangs
+        into clean exits."""
+        t = threading.Thread(target=self.flush, daemon=True)
+        t.start()
+        t.join(timeout)
+
+    def close(self) -> None:
+        self.flush()
+        with self._io_lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    # -- publishing -------------------------------------------------------
+
+    def emit(self, ev: str, **fields) -> None:
+        t = round(self._t_off + time.monotonic() - self._t0, 6)
+        doc = {"t": t, "ev": ev}
+        for k, v in fields.items():
+            doc[k] = _clean(v)
+        line = encode_event(doc) + "\n"
+        with self._lock:
+            self._buf.append(line)
+            self.n_events += 1
+            do_flush = len(self._buf) >= FLUSH_EVERY
+            self._aggregate(ev, t, doc)
+        # NOTE: watchdog_trip is deliberately NOT in the force-flush
+        # set — the watchdog thread must never block on a hung
+        # filesystem (it uses flush_best_effort instead)
+        if do_flush or ev in (
+            "level_commit", "run_end", "checkpoint", "integrity",
+        ):
+            self.flush()
+
+    def _aggregate(self, ev: str, t: float, doc: dict) -> None:
+        if ev == "dispatch":
+            self.dispatches += 1
+            self._lvl_dispatches += 1
+        elif ev == "fetch":
+            self.fetches += 1
+            self._lvl_fetches += 1
+            self.fetch_wait_s += float(doc.get("s") or 0.0)
+            self.fetch_bytes += int(doc.get("b") or 0)
+        elif ev == "level_commit":
+            self.levels += 1
+            self.level_seconds.append(round(t - self._last_boundary, 6))
+            self._last_boundary = t
+            self.level_new.append(int(doc.get("n_new") or 0))
+            self.dispatches_per_level.append(self._lvl_dispatches)
+            self.fetches_per_level.append(self._lvl_fetches)
+            self._lvl_dispatches = 0
+            self._lvl_fetches = 0
+            self.slab_cap = int(doc.get("slab_cap") or 0)
+            self.distinct = int(doc.get("distinct") or 0)
+        elif ev == "compile":
+            if doc.get("declared"):
+                self.prewarm_compiles += 1
+            else:
+                self.compiles += 1
+            self.compile_s += float(doc.get("s") or 0.0)
+        elif ev == "checkpoint":
+            self.checkpoints += 1
+            self.checkpoint_s += float(doc.get("s") or 0.0)
+            self.checkpoint_bytes += int(doc.get("b") or 0)
+        elif ev == "grow":
+            b = str(doc.get("budget"))
+            self.grows[b] = self.grows.get(b, 0) + 1
+        elif ev == "redo":
+            self.redos += 1
+        elif ev == "superstep_commit":
+            self.supersteps += 1
+            self.superstep_levels += int(doc.get("levels") or 0)
+        elif ev == "watchdog_arm":
+            self.watchdog_armed += 1
+        elif ev == "watchdog_trip":
+            self.watchdog_trips += 1
+        elif ev == "audit":
+            self.audit_levels += 1
+            self.audit_rows += int(doc.get("rows") or 0)
+            self.audit_problems += int(doc.get("problems") or 0)
+        elif ev == "retire":
+            self.retired += 1
+        elif ev == "exchange":
+            self.exchange_bytes += int(doc.get("bytes") or 0)
+            self.exchange_raw_bytes += int(doc.get("raw") or 0)
+        elif ev == "integrity":
+            self.integrity_failures += 1
+        elif ev == "run_begin":
+            self._last_boundary = t
+
+    # -- reporting --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The ``--json`` ``telemetry`` block (also bench.py's source
+        for ``level_seconds`` / ``dispatches_per_level``)."""
+        with self._lock:
+            out = dict(
+                events=self.n_events,
+                file=self.path,
+                levels=self.levels,
+                level_seconds=list(self.level_seconds),
+                level_new=list(self.level_new),
+                dispatches=self.dispatches,
+                dispatches_per_level=list(self.dispatches_per_level),
+                fetches=self.fetches,
+                fetches_per_level=list(self.fetches_per_level),
+                fetch_wait_s=round(self.fetch_wait_s, 6),
+                fetch_bytes=self.fetch_bytes,
+                compiles=self.compiles,
+                prewarm_compiles=self.prewarm_compiles,
+                compile_s=round(self.compile_s, 3),
+                checkpoints=self.checkpoints,
+                checkpoint_s=round(self.checkpoint_s, 6),
+                checkpoint_bytes=self.checkpoint_bytes,
+                grows=dict(self.grows),
+                redos=self.redos,
+                supersteps=self.supersteps,
+                superstep_levels=self.superstep_levels,
+                levels_per_dispatch=round(
+                    self.levels / max(self.dispatches, 1), 3
+                ),
+                watchdog=dict(
+                    armed=self.watchdog_armed, trips=self.watchdog_trips
+                ),
+                retired=self.retired,
+                integrity_failures=self.integrity_failures,
+            )
+            if self.audit_levels:
+                out["audit"] = dict(
+                    levels=self.audit_levels, rows=self.audit_rows,
+                    problems=self.audit_problems,
+                )
+            if self.exchange_bytes or self.exchange_raw_bytes:
+                out["exchange_bytes"] = self.exchange_bytes
+                out["exchange_raw_bytes"] = self.exchange_raw_bytes
+            if self.slab_cap:
+                out["slab_cap"] = self.slab_cap
+                out["slab_load"] = round(
+                    self.distinct / max(self.slab_cap, 1), 4
+                )
+            return out
+
+
+# -- publishing hooks (each is a no-op unless a hub is installed) ---------
+# The fast path is ONE global read + ONE branch: with telemetry off the
+# engines pay nothing measurable per event site.
+
+def emit(ev: str, **fields) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit(ev, **fields)
+
+
+def run_begin(**fields) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("run_begin", wall=time.time(), **fields)
+
+
+def run_end(**fields) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("run_end", **fields)
+
+
+def level_begin(level, frontier) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("level_begin", level=level, frontier=frontier)
+
+
+def level_commit(level, n_new, distinct, generated,
+                 slab_cap: int = 0) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("level_commit", level=level, n_new=n_new,
+                 distinct=distinct, generated=generated,
+                 slab_cap=slab_cap)
+
+
+def superstep_begin(**fields) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("superstep_begin", **fields)
+
+
+def superstep_commit(levels, **fields) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("superstep_commit", levels=levels, **fields)
+
+
+def dispatch(tag: str) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("dispatch", tag=tag)
+
+
+def fetch_done(seconds: float, nbytes: int = 0) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("fetch", s=round(seconds, 6), b=nbytes)
+
+
+def compile_done(seconds: float, declared: bool) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("compile", s=round(seconds, 4), declared=declared)
+
+
+def checkpoint(kind: str, name: str, seconds: float,
+               nbytes: int) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("checkpoint", kind=kind, name=name,
+                 s=round(seconds, 6), b=nbytes)
+
+
+def grow(budget: str, to=None) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("grow", budget=budget, to=to)
+
+
+def redo(budget: str) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("redo", budget=budget)
+
+
+def watchdog_arm(context: str, budget: float) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("watchdog_arm", ctx=context, budget=round(budget, 3))
+
+
+def watchdog_trip(context: str, stage: str) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("watchdog_trip", ctx=context, stage=stage)
+
+
+def audit(level, rows, problems) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("audit", level=level, rows=rows, problems=problems)
+
+
+def retire(slot, ok, depth, violation=None) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("retire", slot=slot, ok=ok, depth=depth,
+                 violation=violation)
+
+
+def exchange(level, nbytes, raw, candidates=0, sieved=0) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("exchange", level=level, bytes=nbytes, raw=raw,
+                 candidates=candidates, sieved=sieved)
+
+
+def skew(level, value) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("skew", level=level, skew=round(float(value), 4))
+
+
+def shape(reason: str) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("shape", reason=reason)
+
+
+def integrity(what: str) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("integrity", what=what)

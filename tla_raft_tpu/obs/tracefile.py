@@ -1,0 +1,156 @@
+"""Chrome trace-event export: the run timeline, Perfetto-loadable.
+
+Converts an ``events.jsonl`` stream (obs/telemetry.py) into the Chrome
+trace-event JSON format — load the output in https://ui.perfetto.dev
+(or ``chrome://tracing``) to SEE superstep dispatch amortization and
+the expand/fetch/checkpoint overlap the async pipeline buys.
+
+Track layout (one pid, one tid per track):
+
+=====  ==================  ==========================================
+tid    track               events
+=====  ==================  ==========================================
+1      levels              one ``X`` slice per committed level
+                           (boundary-to-boundary wall time)
+2      superstep windows   ``B``/``E`` pairs per resident dispatch
+                           window
+3      device dispatch     instants, one per program dispatch (tag)
+4      fetch window        ``X`` slices, one per ledgered pipeline
+                           fetch (the measured wait)
+5      checkpoint I/O      ``X`` slices, one per atomic commit
+6      compile             ``X`` slices, one per XLA backend compile
+7      grow/redo           instants (named budget)
+8      watchdog/audit      instants (arm/trip, audit, retire,
+                           integrity)
+=====  ==================  ==========================================
+
+Timestamps are microseconds on the hub's monotonic clock, so every
+``ts`` is non-negative and non-decreasing per track, and every ``B``
+has a matching ``E`` (a window left open by a crash is closed at the
+stream's last timestamp).  Host-pure (graftlint GL012): stdlib only.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .telemetry import read_events
+
+PID = 1
+TRACKS = {
+    1: "levels",
+    2: "superstep windows",
+    3: "device dispatch",
+    4: "fetch window",
+    5: "checkpoint I/O",
+    6: "compile",
+    7: "grow/redo",
+    8: "watchdog/audit",
+}
+
+
+def _us(t: float) -> int:
+    return max(0, int(round(float(t) * 1e6)))
+
+
+def to_chrome_trace(events: list[dict]) -> dict:
+    """Event stream -> Chrome trace-event JSON document."""
+    out: list[dict] = []
+    for tid, name in TRACKS.items():
+        out.append(dict(
+            ph="M", pid=PID, tid=tid, name="thread_name",
+            args=dict(name=name),
+        ))
+
+    def ev(ph, tid, name, t, dur=None, args=None):
+        e = dict(ph=ph, pid=PID, tid=tid, name=str(name), ts=_us(t),
+                 cat="tla-raft")
+        if dur is not None:
+            e["dur"] = max(0, int(round(dur * 1e6)))
+        if args:
+            e["args"] = args
+        out.append(e)
+
+    boundary = 0.0
+    open_window = None
+    last_t = 0.0
+    for doc in events:
+        t = float(doc.get("t", 0.0))
+        last_t = max(last_t, t)
+        kind = doc.get("ev")
+        if kind == "run_begin":
+            boundary = t
+            ev("i", 1, "run_begin", t, args={
+                k: v for k, v in doc.items() if k not in ("t", "ev")
+            })
+        elif kind == "run_end":
+            ev("i", 1, "run_end", t, args={
+                k: v for k, v in doc.items() if k not in ("t", "ev")
+            })
+        elif kind == "level_commit":
+            ev("X", 1, f"level {doc.get('level')}", boundary,
+               dur=t - boundary,
+               args=dict(n_new=doc.get("n_new"),
+                         distinct=doc.get("distinct"),
+                         generated=doc.get("generated")))
+            boundary = t
+        elif kind == "superstep_begin":
+            if open_window is not None:
+                # a begin with no commit (stopped window re-entered):
+                # close the dangling B so pairs stay matched
+                ev("E", 2, "superstep", t)
+            ev("B", 2, "superstep", t)
+            open_window = t
+        elif kind == "superstep_commit":
+            if open_window is None:
+                ev("B", 2, "superstep", t)
+            ev("E", 2, "superstep", t,
+               args=dict(levels=doc.get("levels")))
+            open_window = None
+        elif kind == "dispatch":
+            ev("i", 3, doc.get("tag", "dispatch"), t)
+        elif kind == "fetch":
+            s = float(doc.get("s") or 0.0)
+            ev("X", 4, "fetch", t - s, dur=s,
+               args=dict(bytes=doc.get("b")))
+        elif kind == "checkpoint":
+            s = float(doc.get("s") or 0.0)
+            ev("X", 5, f"commit {doc.get('kind')}", t - s, dur=s,
+               args=dict(name=doc.get("name"), bytes=doc.get("b")))
+        elif kind == "compile":
+            s = float(doc.get("s") or 0.0)
+            ev("X", 6,
+               "prewarm compile" if doc.get("declared") else "compile",
+               t - s, dur=s)
+        elif kind in ("grow", "redo"):
+            ev("i", 7, f"{kind} {doc.get('budget')}", t)
+        elif kind == "watchdog_arm":
+            ev("i", 8, "watchdog arm", t,
+               args=dict(ctx=doc.get("ctx"), budget=doc.get("budget")))
+        elif kind == "watchdog_trip":
+            ev("i", 8, f"WATCHDOG TRIP ({doc.get('stage')})", t,
+               args=dict(ctx=doc.get("ctx")))
+        elif kind in ("audit", "retire", "integrity", "shape",
+                      "exchange", "skew"):
+            ev("i", 8, kind, t, args={
+                k: v for k, v in doc.items() if k not in ("t", "ev")
+            })
+    if open_window is not None:
+        ev("E", 2, "superstep", last_t)
+    return dict(
+        traceEvents=out,
+        displayTimeUnit="ms",
+        otherData=dict(source="tla_raft_tpu.obs"),
+    )
+
+
+def export(events_path: str, out_path: str) -> dict:
+    """events.jsonl -> Chrome trace JSON file; returns small stats."""
+    events, dropped = read_events(events_path)
+    doc = to_chrome_trace(events)
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    return dict(
+        events=len(events), dropped=dropped,
+        trace_events=len(doc["traceEvents"]), out=out_path,
+    )

@@ -192,6 +192,14 @@ class ExchangeMeter:
         cur["raw_bytes"] = raw
         cur["reduction"] = round(raw / exchanged, 2) if exchanged else None
         self.levels.append(cur)
+        # per-level exchange bytes into the telemetry hub (the flight
+        # recorder is the unified sink; summary() keeps the CLI view)
+        from ..obs import telemetry as _obs
+
+        _obs.exchange(
+            cur["level"], exchanged, raw,
+            candidates=cur["n_candidates"], sieved=cur["n_sieved"],
+        )
         return cur
 
     def summary(self) -> dict:

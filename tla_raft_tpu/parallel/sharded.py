@@ -55,6 +55,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import resilience
 from ..analysis import sanitize as graft_sanitize
+from ..obs import telemetry as graft_obs
 from ..config import RaftConfig
 from ..engine import pipeline as graft_pipeline
 from ..engine.bfs import _compact_payloads
@@ -2136,6 +2137,7 @@ class ShardedChecker:
             level_sizes.append(n_new)
             depth += 1
             trace_levels.append((out["gpidx"], out["slots"]))
+            graft_obs.level_commit(depth, n_new, distinct, generated)
             if self.progress is not None:
                 st = out["stats"]
                 self.progress(
@@ -3083,6 +3085,7 @@ class ShardedChecker:
             trace_levels.append(
                 (np.asarray(gp_np, np.int64), np.asarray(sl_np, np.int64))
             )
+            graft_obs.level_commit(depth, n_new, distinct, generated)
             if self.progress is not None:
                 self.progress(
                     dict(

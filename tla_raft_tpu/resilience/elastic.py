@@ -48,6 +48,7 @@ import time
 
 import numpy as np
 
+from ..obs import telemetry as _obs
 from . import faults, recover
 
 
@@ -237,6 +238,7 @@ class Watchdog:
             )
             self._cv.notify_all()
         self._ensure_thread()
+        _obs.watchdog_arm(context, budget)
 
     def touch(self) -> None:
         """Progress heartbeat (async fetch completions, store inserts):
@@ -306,6 +308,7 @@ class Watchdog:
             file=sys.stderr,
         )
         sys.stderr.flush()
+        _obs.watchdog_trip(a["context"], "soft")
         recover.request_preempt()
         # the grace scales with the armed budget (a level trusted with
         # a 2-minute budget earns a proportionate wind-down) so a slow-
@@ -324,4 +327,12 @@ class Watchdog:
             "(state through the last committed level is durable)",
             file=sys.stderr,
         )
+        _obs.watchdog_trip(a["context"], "hard")
+        hub = _obs.current()
+        if hub is not None:
+            # about to os._exit: the trip should reach the flight
+            # recorder — but BOUNDED (side thread + timeout): a hung
+            # filesystem is exactly the failure class this path
+            # converts into exit 75, so it must never block on one
+            hub.flush_best_effort()
         self._hard()

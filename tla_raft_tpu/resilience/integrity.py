@@ -37,6 +37,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..obs import telemetry as _obs
+
 
 class IntegrityError(RuntimeError):
     """An always-on conservation invariant failed: counts upstream of
@@ -59,6 +61,7 @@ def reconcile(what: str, admitted: int, materialized: int,
     """Owner-side admissions must equal origin-side materializations."""
     if int(admitted) != int(materialized):
         at = f" at level {level}" if level is not None else ""
+        _obs.integrity(f"reconcile: {what}{at}")
         raise IntegrityError(
             f"conservation check failed{at}: {what} admitted "
             f"{int(admitted)} new state(s) but {int(materialized)} were "
@@ -72,6 +75,7 @@ def occupancy_check(what: str, occupancy: int, distinct: int,
     """A visited structure's live entries must count the distinct set."""
     if int(occupancy) != int(distinct):
         at = f" at level {level}" if level is not None else ""
+        _obs.integrity(f"occupancy: {what}{at}")
         raise IntegrityError(
             f"occupancy check failed{at}: {what} holds {int(occupancy)} "
             f"live entrie(s) for {int(distinct)} distinct state(s) — a "
@@ -128,6 +132,10 @@ class SkewMeter:
             rows = np.asarray(rows, np.int64).reshape(-1)[: self.D]
             self.rows[: len(rows)] += rows
             s = self._skew(rows)
+            # per-level straggler signal into the flight recorder (the
+            # hub is the unified sink; summary() keeps the cumulative
+            # --json view)
+            _obs.skew(level, s)
             if s > self.peak_row_skew:
                 self.peak_row_skew = s
                 self.worst_owner = int(np.argmax(rows))

@@ -36,8 +36,11 @@ import hashlib
 import json
 import os
 import re
+import time
 
 import numpy as np
+
+from ..obs import telemetry as _obs
 
 from . import faults
 
@@ -288,6 +291,7 @@ def commit_npz(
     graftlint rule GL009 pins that no ``np.savez``/``os.replace``
     checkpoint write exists outside this module.
     """
+    t0 = time.monotonic()
     os.makedirs(ckdir, exist_ok=True)
     tmp = os.path.join(ckdir, TMP_PREFIX + name)
     save = np.savez_compressed if compressed else np.savez
@@ -304,6 +308,7 @@ def commit_npz(
         m.record(name, kind=kind, depth=depth, algo=algo, digest=dig,
                  nbytes=nbytes)
         m.commit()
+    _obs.checkpoint(kind, name, time.monotonic() - t0, nbytes)
     return final
 
 
@@ -330,6 +335,7 @@ def commit_json(
     (worker lease heartbeats): the write stays atomic but skips the
     per-directory ledger commit.
     """
+    t0 = time.monotonic()
     os.makedirs(ckdir, exist_ok=True)
     tmp = os.path.join(ckdir, TMP_PREFIX + name)
     with open(tmp, "w", encoding="utf-8") as fh:
@@ -347,6 +353,14 @@ def commit_json(
         m.record(name, kind=kind, depth=depth, algo=algo, digest=dig,
                  nbytes=nbytes)
         m.commit()
+    if manifest and kind != "metrics":
+        # skip the periodic-housekeeping writers: the metrics snapshot
+        # is the telemetry system writing about itself, and
+        # manifest=False marks high-churn records (lease heartbeats,
+        # every ttl/3 per job from the beater thread) — recording
+        # either would grow the event stream one non-progress line per
+        # tick forever and inflate the checkpoint aggregates
+        _obs.checkpoint(kind, name, time.monotonic() - t0, nbytes)
     return final
 
 

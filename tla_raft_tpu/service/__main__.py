@@ -84,6 +84,21 @@ def _cmd_status(args) -> int:
     from .queue import JobQueue
 
     q = JobQueue(args.root)
+    if args.metrics:
+        # the daemon's per-pass atomic snapshot (obs/metrics.py):
+        # queue depth, lease ages, jobs/hour, poisoned count
+        from ..obs import metrics as obs_metrics
+
+        doc = obs_metrics.load(args.root)
+        if doc is None:
+            print(f"{args.root}: no readable metrics.json "
+                  "(daemon not run yet?)", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(doc))
+        else:
+            obs_metrics.render(doc)
+        return 0
     if args.job:
         try:
             st = q.load_state(args.job)
@@ -195,6 +210,12 @@ def _cmd_run(args, raw_argv) -> int:
     sched = Scheduler(
         q, batch=not args.no_batch, min_bucket=args.min_bucket,
     )
+    if args.progress:
+        # live per-level line for whatever bucket/job is on the device
+        from ..obs.progress import ProgressLine
+
+        pl = ProgressLine(stream=sys.stderr)
+        sched.progress = pl.write
     try:
         if args.once:
             stats = sched.run_once()
@@ -238,6 +259,9 @@ def main(argv=None) -> int:
     pt = sub.add_parser("status", help="queue or per-job status")
     pt.add_argument("--root", required=True)
     pt.add_argument("--job", default=None)
+    pt.add_argument("--metrics", action="store_true",
+                    help="render the daemon's metrics.json snapshot "
+                         "(queue depth, lease ages, jobs/h, poisoned)")
     pt.add_argument("--json", action="store_true")
 
     pr = sub.add_parser("results", help="print a job's summary")
@@ -268,6 +292,9 @@ def main(argv=None) -> int:
     pd.add_argument("--supervise", type=int, default=0, metavar="N",
                     help="relaunch a crashed/preempted scheduler up "
                          "to N times")
+    pd.add_argument("--progress", action="store_true",
+                    help="live one-line progress for the in-flight "
+                         "bucket/job (states/s, configs alive, ETA)")
 
     args = p.parse_args(argv)
     if args.cmd == "submit":

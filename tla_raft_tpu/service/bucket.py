@@ -64,6 +64,7 @@ import jax.numpy as jnp
 
 from .. import resilience
 from ..analysis import sanitize as graft_sanitize
+from ..obs import telemetry as graft_obs
 from ..config import RaftConfig
 from ..engine import forecast
 from ..engine import megakernel as graft_megakernel
@@ -653,6 +654,11 @@ class BatchedChecker:
 
         def finish(c, ok, kind=None):
             done[c] = True
+            # bucket-member retirement into the flight recorder: the
+            # service timeline shows WHEN each tenant config stopped
+            # (fixpoint / depth cap / violation) inside the shared
+            # dispatch stream
+            graft_obs.retire(c, bool(ok), int(depth[c]), kind)
             results[c] = dict(
                 ok=bool(ok),
                 distinct=int(sum(level_sizes[c])),
@@ -870,6 +876,11 @@ class BatchedChecker:
                     )
                     last_n_g = int(m_ng[i])
                     lvl += 1
+                    graft_obs.level_commit(
+                        lvl, level_totals[-1],
+                        int(sum(sum(ls) for ls in level_sizes)),
+                        int(gen.sum()),
+                    )
                     if self.progress is not None:
                         self.progress(
                             dict(
@@ -1077,6 +1088,11 @@ class BatchedChecker:
             last_n_g = n_g
             lvl += 1
 
+            graft_obs.level_commit(
+                lvl, int(sum(int(x) for x in new_c[:C])),
+                int(sum(sum(ls) for ls in level_sizes)),
+                int(gen.sum()),
+            )
             if self.progress is not None:
                 self.progress(
                     dict(

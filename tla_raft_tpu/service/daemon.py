@@ -33,6 +33,7 @@ import time
 
 from .. import resilience
 from ..check import run_check, summary_public
+from ..obs import metrics as obs_metrics
 from .bucket import BatchedChecker, bucket_key
 from .queue import JobQueue, doc_to_cfg
 
@@ -109,6 +110,13 @@ class Scheduler:
             sequential_jobs=0, max_bucket=0, dispatches=0, programs=0,
             recovered=0, config_dispatch_weight=0, poisoned=0,
         )
+        # service metrics registry (obs/metrics.py): snapshots commit
+        # atomically to <root>/metrics.json after every scheduler pass
+        # — `service status --metrics` and external scrapers read a
+        # digest-verified document, never a torn one
+        self.metrics = obs_metrics.Metrics()
+        self._t0 = time.monotonic()
+        self.progress = None  # per-level stats callback (run --progress)
 
     def _say(self, msg: str) -> None:
         print(f"[service] {msg}", file=self.out)
@@ -175,10 +183,24 @@ class Scheduler:
         )
         bc = BatchedChecker(
             cfgs, max_depths=depths, use_mxu=self.use_mxu,
+            progress=self.progress,
         )
         bdir = self._bucket_ck(bc._run_fp)
+        # bucket flight recorder: one events.jsonl next to the bucket's
+        # bstate snapshots (level commits, dispatches, per-config
+        # retirements), unless an outer hub is already installed
+        from ..obs import telemetry as obs_telemetry
+
+        if obs_telemetry.enabled_by_env() and (
+            obs_telemetry.current() is None
+        ):
+            hubctx = obs_telemetry.TelemetryHub(run_dir=bdir)
+        else:
+            import contextlib
+
+            hubctx = contextlib.nullcontext()
         try:
-            with _Beater(self.q, jids):
+            with _Beater(self.q, jids), hubctx:
                 summaries = bc.run(checkpoint_dir=bdir)
         except resilience.Preempted:
             for j in jids:
@@ -265,6 +287,41 @@ class Scheduler:
         self.stats["sequential_jobs"] += 1
         self.stats["jobs_done" if summary["ok"] else "jobs_failed"] += 1
 
+    # -- metrics -------------------------------------------------------
+
+    def _commit_metrics(self) -> None:
+        """Fold the pass's stats into the registry and commit the
+        snapshot atomically (one fresh scan: the pass just mutated the
+        queue, so the pre-pass ``states`` map is stale by now)."""
+        m = self.metrics
+        by: dict[str, int] = {}
+        ages: list[float] = []
+        for jid, st in self.q.scan().items():
+            by[st["status"]] = by.get(st["status"], 0) + 1
+            if st["status"] == "running":
+                age = self.q.lease_age(jid)
+                if age is not None:
+                    ages.append(age)
+        for s in ("submitted", "running", "done", "failed"):
+            m.gauge(f"queue_{s}").set(by.get(s, 0))
+        m.gauge("queue_depth").set(by.get("submitted", 0))
+        m.gauge("lease_age_max_s").set(round(max(ages), 3) if ages
+                                       else 0.0)
+        hours = max(time.monotonic() - self._t0, 1e-9) / 3600.0
+        m.gauge("jobs_per_hour").set(
+            round(self.stats["jobs_done"] / hours, 2)
+        )
+        for k in ("jobs_done", "jobs_failed", "poisoned", "buckets",
+                  "batched_jobs", "sequential_jobs", "dispatches",
+                  "programs", "recovered"):
+            m.counter(k).set(self.stats[k])
+        try:
+            m.commit(self.q.root)
+        except OSError as e:
+            # metrics are observability, not correctness: a full disk
+            # must not take the scheduler down
+            self._say(f"metrics commit failed: {e}")
+
     # -- passes --------------------------------------------------------
 
     def run_once(self) -> dict:
@@ -291,15 +348,20 @@ class Scheduler:
             )
         pending = self.q.pending(states)
         buckets, singles = self.plan(pending)
-        for key, jobs in buckets:
-            if resilience.preempt_requested():
-                raise resilience.Preempted(None, 0)
-            self._run_bucket(key, jobs)
-        for jid, spec in singles:
-            if resilience.preempt_requested():
-                raise resilience.Preempted(None, 0)
-            if self.q.claim(jid):
-                self._run_one(jid, spec)
+        try:
+            for key, jobs in buckets:
+                if resilience.preempt_requested():
+                    raise resilience.Preempted(None, 0)
+                self._run_bucket(key, jobs)
+            for jid, spec in singles:
+                if resilience.preempt_requested():
+                    raise resilience.Preempted(None, 0)
+                if self.q.claim(jid):
+                    self._run_one(jid, spec)
+        finally:
+            # commit metrics even on a preempted pass: the snapshot a
+            # scraper reads should reflect the work actually done
+            self._commit_metrics()
         return dict(self.stats)
 
     def serve(self, poll: float = 2.0, max_idle: float | None = None):
