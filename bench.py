@@ -37,7 +37,9 @@ BENCH_VALS / BENCH_MAX_ELECTION (scale dials, BASELINE.md configs 3-5),
 BENCH_GOLD_DEPTH (oracle prefix depth), RAFT_CFG, BENCH_HASHSTORE (0 =
 sort-path A/B), BENCH_PIPELINE (0 = serial-chain A/B) /
 BENCH_PIPELINE_WINDOW (in-flight fetch groups, default 2), BENCH_MXU
-(0 = legacy per-lane expand A/B), BENCH_MEGAKERNEL (0 = staged
+(0 = legacy per-lane expand A/B), BENCH_TIERED (1 = cap the hot visited
+slab at BENCH_TIERED_BYTES, forcing generation demotions to host/disk —
+the out-of-core tiered-store A/B), BENCH_MEGAKERNEL (0 = staged
 program-chain A/B vs the fused whole-level program; dispatches/level
 land in the record either way), BENCH_SUPERSTEP (0 = per-level fused
 A/B vs the multi-level resident superstep driver; levels_per_dispatch
@@ -607,6 +609,20 @@ def main():
         # sourced FROM the hub (one bookkeeping) instead of bench-local
         # timestamp math; counts are bit-identical either way.
         use_tel = bool(int(os.environ.get("BENCH_TELEMETRY", "1")))
+        # BENCH_TIERED=1 caps the hot visited slab at
+        # BENCH_TIERED_BYTES (default 128 KiB — the reference depth-12
+        # sweep's 47k distinct states overflow its 8,191 resident
+        # entries ~5.7x) so the run demotes whole generations to
+        # host/disk (store/tiered.py) — the out-of-core A/B lever
+        # (docs/PERF.md "Tiered visited store").  Counts are
+        # bit-identical either way; the record carries the demotion +
+        # probe-wait accounting so the spill-overlap acceptance
+        # (probe-wait << level wall) is machine-checkable.
+        tier_bytes = (
+            int(float(os.environ.get("BENCH_TIERED_BYTES",
+                                     str(1 << 17))))
+            if int(os.environ.get("BENCH_TIERED", "0")) else 0
+        )
         # BENCH_AUDIT=1 arms the end-to-end integrity audit at
         # BENCH_AUDIT_N rows/level (default 64) — the A/B lever for the
         # audit-mode overhead record (docs/ROBUSTNESS.md; target < 5%
@@ -672,6 +688,7 @@ def main():
                     pipeline=use_pipe, pipeline_window=pipe_window,
                     use_mxu=use_mxu, megakernel=use_mega, audit=audit_n,
                     superstep=use_superstep,
+                    store_bytes=tier_bytes or None,
                 )
                 res = chk1.run(max_depth=max_depth)
             finally:
@@ -794,7 +811,18 @@ def main():
             int(getattr(chk1, "superstep_span", 1)) if not mesh_n else 1
         ),
         "audit": audit_n if not mesh_n else 0,
+        # the tiered-store lever (0 = hot-only): budget + the demotion
+        # and per-tier probe accounting when it actually spilled
+        "tiered_bytes": tier_bytes if not mesh_n else 0,
     }
+    if not mesh_n and tier_bytes and getattr(chk1, "tiered", None):
+        ts = chk1.tiered.stats
+        out["tiered"] = dict(
+            ts,
+            generations=len(chk1.tiered.gens),
+            probe_wait_s=round(ts["probe_wait_s"], 6),
+            cold_load_s=round(ts["cold_load_s"], 6),
+        )
     if not mesh_n:
         # per-level wall clock + program dispatches (the fused-vs-
         # staged A/B's secondary metric: launches/level is exactly
@@ -874,7 +902,7 @@ def main():
         for k in ("mesh", "mesh_deep", "peak_dev_rows", "exchange",
                   "telemetry", "level_seconds", "dispatches_per_level",
                   "steady_max_dispatches_per_level",
-                  "levels_per_dispatch"):
+                  "levels_per_dispatch", "tiered_bytes", "tiered"):
             if k in out:
                 record[k] = out[k]
         tmp = bench_out + ".tmp"

@@ -174,6 +174,8 @@ def test_elastic_deep_kill_resume_4_to_2_via_cli(tmp_path, golden_s2):
     assert not glob.glob(os.path.join(ck, ".tmp_*"))
 
 
+@pytest.mark.slow  # tier-1 budget (PR 12): the 4 -> 2 CLI kill row
+# and the owner_rebalance units keep deep elastic in the fast tier
 def test_elastic_deep_resume_2_to_4_and_mixed_chain(tmp_path, golden_s2):
     """The opposite direction in-process (2 -> 4), then a full replay
     of the resulting MIXED-geometry chain (2-device prefix + rewritten
@@ -199,6 +201,9 @@ def test_elastic_deep_resume_2_to_4_and_mixed_chain(tmp_path, golden_s2):
     _assert_golden(res8, golden_s2)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 12): deep elastic (CLI kill
+# 4 -> 2) stays fast; the plain-mesh slab rehash rides the replay
+# machinery those rows already gate
 def test_elastic_plain_mesh_both_directions(tmp_path, golden_s2):
     """Plain (non-deep) mesh elastic resume, 4 -> 2 and 2 -> 4: the
     device-resident visited slabs rehash into the new fp %% D'

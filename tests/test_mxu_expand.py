@@ -135,6 +135,9 @@ def test_engine_parity_s2_fixpoint():
     assert b.distinct == 50 and b.depth == 12
 
 
+@pytest.mark.slow  # tier-1 budget (PR 12): the S2 cross + 3121
+# prefix rows keep MXU parity fast; test_hashstore pins the S3V1
+# fixpoint with the shipped (MXU-on) default
 def test_engine_parity_s3v1_fixpoint_hashstore_cross():
     runs = {
         (mxu, hs): JaxChecker(
@@ -165,6 +168,8 @@ def test_engine_parity_golden_full_3121():
 
 # -- mesh parity ----------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget (PR 12): test_hashstore's mesh
+# a2a hash-vs-sorted row (MXU default on) keeps a2a parity fast
 def test_mesh_a2a_parity(tmp_path):
     if len(jax.devices()) < 4:
         pytest.skip("not enough virtual devices")
@@ -177,6 +182,8 @@ def test_mesh_a2a_parity(tmp_path):
     assert a.action_counts == b.action_counts
 
 
+@pytest.mark.slow  # tier-1 budget (PR 12): test_hashstore's deep
+# golden-prefix row (MXU default on) keeps this anchor fast
 def test_mesh_deep_golden_prefix_mxu(tmp_path):
     """The deep-sweep acceptance prefix with the MXU expand on: the
     reference constants to depth 8 must land on the golden 1505

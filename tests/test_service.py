@@ -186,6 +186,8 @@ def test_batched_parity_bucket():
         assert g["batched"] is True
 
 
+@pytest.mark.slow  # tier-1 budget (PR 12): the split-brain abort row
+# below keeps violating-member batched verdicts in the fast tier
 def test_batched_violation_parity():
     """A violated (negated-probe) invariant stops each config at the
     same counts and with the same violation string as check.py.

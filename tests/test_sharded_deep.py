@@ -93,6 +93,9 @@ def test_deep_parity_8dev_s3_vs_engine(tmp_path):
     assert chk.peak_dev_rows < peak_level
 
 
+@pytest.mark.slow  # tier-1 budget (PR 12): the 8-dev S3-vs-engine
+# parity row keeps the deep path fast; this reference-constants
+# depth-8 anchor is the chip-campaign acceptance row
 def test_deep_parity_reference_depth8(tmp_path):
     """The acceptance run: the reference Raft.cfg constants on the
     8-device mesh to depth 8, bit-identical per-level distinct/

@@ -71,6 +71,7 @@ GL010_KERNELS = (
     "dense.expand",
     "engine.megakernel_level",
     "engine.superstep",
+    "store.tiered_compact",
 )
 
 
@@ -106,6 +107,7 @@ def kernel_registry():
 
     from ..engine import megakernel as megakernel_mod
     from ..engine import superstep as superstep_mod
+    from ..store import tiered as tiered_mod
     from ..models.raft import init_batch
     from ..ops import hashstore
     from ..ops.successor import get_kernel
@@ -169,6 +171,13 @@ def kernel_registry():
         # which must stay drop-mode scatters (no data-indexed gathers)
         "engine.superstep":
             lambda: superstep_mod.ledger_trace(cfg),
+        # the tiered store's one device program (store/tiered.py):
+        # compacting generation-revisit rows out of a materialized
+        # frontier — the budget pins ONE data-indexed gather per
+        # frontier field (the stable-argsort row permutation), so the
+        # level-tail correction can never grow a gather storm
+        "store.tiered_compact":
+            lambda: tiered_mod.ledger_trace(cfg),
     }
 
 

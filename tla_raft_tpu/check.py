@@ -236,6 +236,19 @@ def summarize(res, chk=None, seconds: float | None = None,
     aud = getattr(chk, "audit_stats", None)
     if aud and aud.get("levels"):
         out["audit"] = dict(aud)
+    # tiered visited store (store/tiered.py): demotion + per-tier probe
+    # accounting — present once a device budget actually spilled
+    tiered = getattr(chk, "tiered", None)
+    if tiered is not None and (
+        tiered.stats["demotions"] or tiered.stats["probes"]
+    ):
+        out["tiered"] = dict(
+            tiered.stats,
+            dev_bytes=tiered.dev_bytes,
+            generations=len(tiered.gens),
+            probe_wait_s=round(tiered.stats["probe_wait_s"], 6),
+            cold_load_s=round(tiered.stats["cold_load_s"], 6),
+        )
     # per-owner straggler/skew metrics (mesh runs); kept at top level
     # for compatibility AND folded into the telemetry block below
     skew = getattr(chk, "skew", None)
@@ -283,6 +296,8 @@ def run_check(
     audit_retries: int = 3,
     watchdog: float = 0.0,
     telemetry: bool | None = None,
+    dev_bytes: int | None = None,
+    warm_bytes: int | None = None,
     progress=None,
     out=None,
     install_signals: bool = False,
@@ -348,6 +363,7 @@ def run_check(
             use_mxu=use_mxu, megakernel=megakernel,
             superstep=superstep, audit=audit,
             audit_retries=audit_retries, watchdog=watchdog,
+            dev_bytes=dev_bytes, warm_bytes=warm_bytes,
             hub=hub, progress=progress, out=out,
             install_signals=install_signals,
         )
@@ -385,6 +401,8 @@ def _run_check_impl(
     audit,
     audit_retries,
     watchdog,
+    dev_bytes,
+    warm_bytes,
     hub,
     progress,
     out,
@@ -394,6 +412,12 @@ def _run_check_impl(
         raise ValueError("mesh_deep requires mesh >= 1")
     if mesh_deep and not fpstore_dir:
         raise ValueError("mesh_deep requires fpstore_dir")
+    if warm_bytes is None and os.environ.get("TLA_RAFT_WARM_BYTES"):
+        # honor the env on the external-store paths too (the tiered
+        # slab path reads it internally); when NEITHER is set those
+        # stores keep their native 64 MiB buffer default — the 1 GiB
+        # tiered-generation default must not silently re-budget them
+        warm_bytes = int(float(os.environ["TLA_RAFT_WARM_BYTES"]))
     out = out if out is not None else _Silent()
     t0 = time.monotonic()
     sanitizer = None
@@ -470,7 +494,15 @@ def _run_check_impl(
         if fpstore_dir and not mesh:
             from .native import HostFPStore
 
-            host_store = HostFPStore(fpstore_dir)
+            # --warm-bytes bounds the store's in-RAM buffer; past it
+            # the native tier spills sorted runs to disk (the warm/cold
+            # boundary of the external-store loop)
+            host_store = HostFPStore(
+                fpstore_dir,
+                mem_budget_entries=(
+                    max(warm_bytes // 8, 1) if warm_bytes else 0
+                ),
+            )
             if not recover:
                 # sweep run files orphaned by a crashed earlier process
                 # (never loaded, but they waste disk and shadow names)
@@ -481,6 +513,14 @@ def _run_check_impl(
             contextlib.nullcontext()
         )
         if mesh:
+            if dev_bytes:
+                print(
+                    "--dev-bytes applies to the single-device engine's "
+                    "hot slab; mesh out-of-core runs tier through the "
+                    "owner-sharded external stores (--fpstore-dir) "
+                    "with --warm-bytes bounding their RAM (flag "
+                    "ignored)", file=out,
+                )
             if fpstore_dir:
                 # mesh x external store: one HostFPStore per owner shard
                 # (fp % D), host-filtered after the all_to_all routing
@@ -501,6 +541,7 @@ def _run_check_impl(
                 pipeline_window=pipeline_window,
                 use_mxu=use_mxu,
                 watchdog=wd,
+                warm_bytes=warm_bytes,
             )
             if audit:
                 print(
@@ -556,7 +597,15 @@ def _run_check_impl(
                     audit=audit,
                     audit_retries=audit_retries,
                     watchdog=wd,
+                    store_bytes=dev_bytes,
+                    warm_bytes=warm_bytes,
                 )
+                if dev_bytes:
+                    print(
+                        f"Tiered visited store: hot slab budget "
+                        f"{dev_bytes:,} B (demotions spill to "
+                        "host/disk generations)", file=out,
+                    )
                 if audit:
                     print(
                         f"Integrity audit: {audit} sampled rows/level "
@@ -727,6 +776,26 @@ def main(argv=None) -> int:
                         "(A/B — counts are bit-identical).  Requires "
                         "the fused path (--megakernel 1); --audit "
                         "forces per-level.  env: TLA_RAFT_SUPERSTEP")
+    p.add_argument("--dev-bytes", type=float, default=None,
+                   metavar="BYTES",
+                   help="device-memory budget for the HOT visited tier "
+                        "(the on-device hash slab): growth past it "
+                        "demotes whole generations to host RAM / disk "
+                        "(store/tiered.py) instead of growing — "
+                        "|visited| becomes storage-bounded like TLC's "
+                        "disk FPSet.  0/unset = unbounded (hot-only; "
+                        "counts are bit-identical either way).  env: "
+                        "TLA_RAFT_STORE_BYTES")
+    p.add_argument("--warm-bytes", type=float, default=None,
+                   metavar="BYTES",
+                   help="host-RAM budget for the WARM tier: demoted "
+                        "generations past it drop to cold (disk-only, "
+                        "re-read through an LRU page cache; default "
+                        "1 GiB); on the external-store paths this "
+                        "bounds the native store's in-RAM buffer "
+                        "before it spills sorted runs (unset = the "
+                        "native 64 MiB default).  env: "
+                        "TLA_RAFT_WARM_BYTES")
     p.add_argument("--no-hashstore", action="store_true",
                    help="revert to the sort-based visited path (lexsort "
                         "+ searchsorted + sorted merge) instead of the "
@@ -889,6 +958,12 @@ def main(argv=None) -> int:
             watchdog=args.watchdog,
             telemetry=(
                 None if args.telemetry is None else bool(args.telemetry)
+            ),
+            dev_bytes=(
+                int(args.dev_bytes) if args.dev_bytes else None
+            ),
+            warm_bytes=(
+                int(args.warm_bytes) if args.warm_bytes else None
             ),
             progress=progress,
             out=out,

@@ -72,6 +72,7 @@ from ..config import RaftConfig
 from ..models.raft import RaftState, init_batch, to_oracle
 from ..ops import hashstore
 from ..ops.successor import SuccessorKernel, get_kernel
+from ..store import tiered as graft_tiered
 from . import megakernel as graft_megakernel
 from . import superstep as graft_superstep
 from . import pipeline as graft_pipeline
@@ -468,6 +469,8 @@ class JaxChecker:
         audit: int = 0,
         audit_retries: int = 3,
         watchdog=None,
+        store_bytes: int | None = None,
+        warm_bytes: int | None = None,
     ):
         # canon="late": expand computes guards only; the compacted
         # candidates are materialized and fingerprinted with the full-state
@@ -543,6 +546,18 @@ class JaxChecker:
         self.use_hashstore = bool(use_hashstore) and host_store is None
         self.hstore = None  # DeviceHashStore, built in run()/resume
         self._hs_pending = None  # a level's updated slab awaiting adoption
+        # tiered visited store (store/tiered.py): a device-byte budget
+        # for the hot slab; growth past it DEMOTES a whole generation
+        # to host RAM / disk instead of growing (or dying), and the
+        # level tail probes the demoted runs host-side, dropping their
+        # revisits from the fresh set — |visited| becomes
+        # storage-bounded, TLC's disk FPSet move.  0 = off (the
+        # hot-only engine, bit-identical counts either way).
+        if store_bytes is None:
+            store_bytes = graft_tiered.store_bytes_from_env()
+        self.store_bytes = int(store_bytes)
+        self.warm_bytes = warm_bytes  # None = TLA_RAFT_WARM_BYTES
+        self.tiered = None  # TieredVisitedStore, built in run()/resume
         # device-byte budget for frontier segments (0 = paging off): when
         # one level's parent+child segments would exceed it, sealed child
         # segments demote to host RAM and page back in on demand — the
@@ -1175,7 +1190,9 @@ class JaxChecker:
             if ctrl[mk.CTRL_OVF_SLAB]:
                 self._hs_pending = None
                 try:
-                    self.hstore.grow()
+                    how = self._slab_grow_or_demote(
+                        len(level_sizes), expected=max(n_new, n_f)
+                    )
                 except Exception as e:  # graftlint: waive[GL003] — any
                     # grow failure (device OOM, injected fault) degrades
                     # to the sort path; the level redoes staged.  The
@@ -1186,8 +1203,14 @@ class JaxChecker:
                     self._degraded_visited = self._degrade_hashstore(e)
                     return dict(degraded=True, parent=frontier)
                 self._mega_stats["redo_slab"] += 1
-                graft_obs.grow("slab", self.hstore.cap)
-                graft_obs.redo("slab")
+                if how == "demoted":
+                    # the tier form of the slab redo: demote, then redo
+                    # against the drained slab (store/tiered.py)
+                    self.tiered.stats["tier_redos"] += 1
+                    graft_obs.redo("slab_tier")
+                else:
+                    graft_obs.grow("slab", self.hstore.cap)
+                    graft_obs.redo("slab")
                 continue
             if ctrl[mk.CTRL_OVF_X]:
                 # a chunk overflowed its compaction budget: the same
@@ -1251,8 +1274,15 @@ class JaxChecker:
     def _superstep_span_at(self, max_depth, depth) -> int:
         """The span this superstep may cover: the configured span,
         clamped so the resident loop never expands past --max-depth
-        (the per-level loop breaks BEFORE expanding at the cap)."""
+        (the per-level loop breaks BEFORE expanding at the cap).
+        Once the tiered store has demoted a generation the span is 1:
+        a resident window cannot host-correct a mid-span level's
+        generation revisits (every level after it would have expanded
+        stale rows), and out-of-core levels are compute-bound anyway —
+        the dispatch floor the superstep amortizes is noise there."""
         span = self.superstep_span
+        if self._tier_active():
+            return 1
         if max_depth is not None:
             span = min(span, max_depth - depth)
         return span
@@ -1325,7 +1355,10 @@ class JaxChecker:
         else:
             ins_bound = 2 * max(n_f, 1)
         try:
-            self.hstore.reserve(
+            # budget-clamped under the tiered store: a span whose
+            # forecast inserts exceed the device budget stops on
+            # FLAG_OVF_SLAB and the stop handler demotes
+            self._tier_reserve(
                 self.hstore.count + max(ins_bound, 2 * max(n_f, 1))
             )
         except Exception as e:  # graftlint: waive[GL003] — grow
@@ -2563,6 +2596,30 @@ class JaxChecker:
         distinct = int(sum(level_sizes))
         if self.host_store is not None:
             visited = jnp.full((64,), SENT, U64)
+        elif self.use_hashstore and self._tier_on():
+            # tiered resume: the dumped slab holds only the HOT tier
+            # (its count deliberately mismatches distinct), so the
+            # replayed per-level fps re-tier from scratch — whole
+            # levels demote together, making the rebuilt generations
+            # DISJOINT and the tier total exactly the distinct count
+            parts = [np.asarray(p, np.uint64) for p in fps_parts]
+            if visited_base is not None:
+                vb = np.asarray(visited_base, np.uint64)
+                parts.insert(0, vb[vb != SENT])
+            hot = self.tiered.rebuild(
+                list(enumerate(parts)),
+                hot_slots=self.tiered.hot_slot_budget(),
+            )
+            self.hstore = hashstore.DeviceHashStore.from_fps(hot)
+            total = self.hstore.count + self.tiered.spilled_distinct()
+            if total != distinct:
+                raise ValueError(
+                    f"tiered resume rebuilt {total} distinct "
+                    f"fingerprints across {1 + len(self.tiered.gens)} "
+                    f"tier(s) for {distinct} recorded states — corrupt "
+                    "or mixed log"
+                )
+            visited = jnp.full((64,), SENT, U64)
         elif self.use_hashstore:
             # slab checkpoint fast path: the dumped slab IS the visited
             # set at the resume depth — one device_put instead of a
@@ -2622,7 +2679,13 @@ class JaxChecker:
             # sorted-mode resumes), so derive it from the live slots
             # graftlint: waive[GL006] — one slab fetch per monolith save
             vb = np.asarray(jax.device_get(self.hstore.slab))
-            vb = np.sort(vb[vb != SENT])
+            vb = vb[vb != SENT]
+            if self._tier_active():
+                # the monolith's visited array IS the fingerprint set:
+                # fold the demoted generations back in (the hot slab
+                # alone is only the top tier)
+                vb = np.union1d(vb, self.tiered.all_fps())
+            vb = np.sort(np.unique(vb)) if len(vb) else vb
             pad = _cap4(len(vb) + 1) - len(vb)
             visited = np.concatenate([vb, np.full(pad, SENT)])
         arrs = {f"st_{k}": np.asarray(v) for k, v in frontier._asdict().items()}
@@ -2651,6 +2714,138 @@ class JaxChecker:
             compressed=total < (256 << 20),
         )
 
+    # -- tiered visited store (store/tiered.py) ---------------------------
+
+    def _tier_on(self) -> bool:
+        """Tiering configured: a device budget bounds the hot slab."""
+        return (
+            self.tiered is not None and self.use_hashstore
+            and self.host_store is None
+        )
+
+    def _tier_active(self) -> bool:
+        """At least one generation demoted: level tails must probe."""
+        return self._tier_on() and self.tiered.active
+
+    def _demote_generation(self, depth: int, expected: int = 0) -> None:
+        """Flush the hot slab into one warm generation and restart hot.
+
+        The restart slab is sized to SEAT the in-flight level's
+        expected fresh set — even past the budget: the device budget
+        bounds the store RESIDENT between levels (that is what makes
+        |visited| storage-bounded), while one level's insert set is a
+        transient working set exactly like the frontier is, and the
+        between-level demote drains any soft overshoot right after the
+        level commits."""
+        # one slab fetch per demotion: the rare budget-crossing event,
+        # same deliberate-sync class as the slab dump / degrade fetches
+        # graftlint: waive[GL006] — demotion's one deliberate slab fetch
+        vb = np.asarray(jax.device_get(self.hstore.slab))
+        self.tiered.demote(vb, depth=depth)
+        want = hashstore.slab_rows(max(2 * max(expected, 1),
+                                       hashstore.MIN_CAP // 2))
+        if not self.tiered.slab_fits(want):
+            soft = hashstore.slab_rows(max(expected, 1))
+            want = max(min(want, soft), hashstore.MIN_CAP)
+        self.hstore = hashstore.DeviceHashStore(cap=want)
+        self._hs_pending = None
+        print(
+            f"[tiered] hot slab demoted to generation "
+            f"{self.tiered.gens[-1].gid if self.tiered.gens else '-'} "
+            f"at level {depth} ({len(vb[vb != SENT])} fps spilled, "
+            f"{self.tiered.spilled_distinct()} total across "
+            f"{len(self.tiered.gens)} gen(s)); hot restarts at "
+            f"{self.hstore.cap} slots"
+            + ("" if self.tiered.slab_fits(self.hstore.cap) else
+               " (soft over-budget: one level's fresh set exceeds the "
+               "hot budget; drained again at the next level boundary)"),
+            file=sys.stderr,
+        )
+
+    def _slab_grow_or_demote(self, depth: int, expected: int = 0,
+                             min_cap: int | None = None) -> str:
+        """The tier-aware form of ``hstore.grow()``: grow while the
+        grown slab still fits the device budget, DEMOTE a generation
+        otherwise ("demote, then redo" where the untiered path would
+        grow or die).  A demotion only helps while the slab has content
+        to flush — an (almost) empty slab that still overflows means
+        ONE level's fresh set exceeds the budget, and the level must be
+        seated transiently (soft overshoot, drained at the next level
+        boundary) or it would redo forever.  Returns "grew" or
+        "demoted"; grow failures propagate so callers keep their
+        degrade-to-sorted ladder."""
+        want = max(self.hstore.cap * 2, min_cap or 0)
+        want = 1 << (want - 1).bit_length()
+        if self._tier_on() and not self.tiered.slab_fits(want):
+            if self.hstore.count > 0:
+                self._demote_generation(depth, expected=expected)
+                return "demoted"
+            print(
+                f"[tiered] level {depth}: fresh set exceeds the hot "
+                f"budget even after demotion — seating it transiently "
+                f"at {want} slots (drained at the level boundary)",
+                file=sys.stderr,
+            )
+        self.hstore.grow(min_cap=min_cap)
+        return "grew"
+
+    def _tier_drain(self, depth: int, n_next: int) -> None:
+        """Between-level demotion check, run at the loop top: drains a
+        slab that sits over the budget (a transient soft-seat, or the
+        MIN_CAP floor under a sub-minimum budget) or whose next growth
+        would bust it.  The only drain site the superstep windows have
+        — their commit path adopts without the staged path's
+        between-level grow — and a no-op while the hot slab can keep
+        growing inside the budget."""
+        if not self._tier_on() or self.hstore.count == 0:
+            return
+        over = not self.tiered.slab_fits(self.hstore.cap)
+        grow_needed = self.hstore.need_grow(extra=2 * max(n_next, 1))
+        grow_busts = not self.tiered.slab_fits(self.hstore.cap * 2)
+        if over or (grow_needed and grow_busts):
+            self._demote_generation(depth, expected=2 * max(n_next, 1))
+
+    def _tier_reserve(self, entries: int) -> None:
+        """Budget-clamped ``hstore.reserve``: never presize the hot
+        slab past the device budget (the overflow path demotes when
+        the level actually needs the room)."""
+        if self._tier_on():
+            cap_e = self.tiered.max_hot_entries
+            if cap_e:
+                entries = min(entries, cap_e)
+        self.hstore.reserve(int(entries))
+
+    def _tier_filter_level(self, depth: int, n_new: int, fps_np,
+                           new_frontier, cap_out: int):
+        """The level-tail generation probe + row compaction.
+
+        ``fps_np`` are the level's kernel-fresh fingerprints (hot-slab
+        verdicts); revisits of demoted generations among them are
+        dropped from the already-materialized frontier with ONE small
+        device program (store.tiered.drop_rows), keeping counts
+        bit-identical to the uncapped run.  The hit fingerprints stay
+        in the hot slab (the fused probe re-inserted them) — that is
+        the re-heat, so the next revisit resolves on device.  Returns
+        ``(n_keep, keep_mask | None, new_frontier)``."""
+        hits = self.tiered.probe(fps_np[:n_new], level=depth + 1)
+        n_hit = int(hits.sum())
+        if not n_hit:
+            return n_new, None, new_frontier
+        self.tiered.stats["reheats"] += n_hit
+        keep = ~hits
+        n_keep = n_new - n_hit
+        if n_keep:
+            keep_dev = jnp.asarray(
+                np.concatenate([
+                    keep, np.zeros(cap_out - n_new, bool),
+                ])
+            )
+            new_frontier = graft_tiered.drop_rows(
+                new_frontier, keep_dev, jnp.asarray(n_keep, I64)
+            )
+            graft_sanitize.note_dispatch("tiered.compact")
+        return n_keep, keep, new_frontier
+
     def _degrade_hashstore(self, why) -> jnp.ndarray:
         """Hash-store grow failed (device OOM or an injected
         ``hashstore.grow`` fault): fall back to the sort-based visited
@@ -2666,7 +2861,14 @@ class JaxChecker:
         )
         # graftlint: waive[GL006] — one-time degradation fetch
         vb = np.asarray(jax.device_get(self.hstore.slab))
-        vb = np.sort(vb[vb != SENT])
+        vb = vb[vb != SENT]
+        if self._tier_active():
+            # the sorted fallback must hold the WHOLE union: fold every
+            # demoted generation back in (host-side; the degraded run
+            # is already off the fast path, correctness first)
+            vb = np.union1d(vb, self.tiered.all_fps())
+            self.tiered = None
+        vb = np.sort(np.unique(vb)) if len(vb) else vb
         pad = _cap4(len(vb) + 1) - len(vb)
         visited = jnp.concatenate(
             [jnp.asarray(vb), jnp.full((pad,), SENT, U64)]
@@ -3171,7 +3373,19 @@ class JaxChecker:
         first = np.ones(len(sv), bool)
         first[1:] = sv[1:] != sv[:-1]
         uniq_v, uniq_p = sv[first], sp[first]
+        t_probe = time.monotonic()
         is_new = self.host_store.insert(uniq_v)
+        if getattr(self.host_store, "num_runs", 0):
+            # the external store holds spilled (disk) runs: this
+            # level's membership verdicts probed the warm/cold tiers —
+            # publish the non-overlapped wait (the group candidates
+            # themselves streamed through the async fetch window, so
+            # the device expanded ahead of this probe)
+            graft_obs.tier_probe(
+                (depth + 1) if depth is not None else 0, len(uniq_v),
+                int(len(uniq_v) - is_new.sum()),
+                wait_s=time.monotonic() - t_probe,
+            )
         new_fps = uniq_v[is_new]
         new_pay = uniq_p[is_new]
         # emit survivors in ASCENDING PAYLOAD order (payload = pidx*K+slot,
@@ -3377,6 +3591,28 @@ class JaxChecker:
                     checkpoint_dir, "base.npz", kind="base",
                     run_fp=self._run_fp,
                 )
+        # tiered visited store: the hot slab lives under a device-byte
+        # budget; demotions spill whole generations to the checkpoint
+        # directory (warm in host RAM, cold on disk — store/tiered.py)
+        if self.store_bytes and self.use_hashstore and (
+            self.host_store is None
+        ):
+            spill = (
+                checkpoint_dir if (checkpoint_dir and checkpoint_every)
+                else (resume_from if (
+                    resume_from and os.path.isdir(resume_from)
+                ) else None)
+            )
+            self.tiered = graft_tiered.TieredVisitedStore(
+                self.store_bytes, warm_bytes=self.warm_bytes,
+                spill_dir=spill, run_fp=self._run_fp,
+            )
+            if spill:
+                # stale generation files (a previous incarnation's, or
+                # a crash mid-demotion) are noise: the delta log is the
+                # source of truth and the resume rebuild re-commits a
+                # fresh, disjoint set
+                graft_tiered.sweep_gens(spill)
         if resume_from is not None:
             if os.path.isdir(resume_from):
                 ck = self._resume_from_deltas(resume_from)
@@ -3400,10 +3636,23 @@ class JaxChecker:
                 elif self.use_hashstore:
                     # a sorted-store monolith resumes onto the hash slab:
                     # its visited array is the fingerprint set — rebuild
-                    # host-side (insert_np), one device_put of the slab
-                    self.hstore = hashstore.DeviceHashStore.from_fps(
-                        np.asarray(ck.pop("visited"))
-                    )
+                    # host-side (insert_np), one device_put of the slab.
+                    # Under a tiered budget the monolith's set re-tiers:
+                    # whatever exceeds the hot budget demotes up front.
+                    vall = np.asarray(ck.pop("visited"))
+                    vall = vall[vall != SENT]
+                    if self._tier_on():
+                        hot = self.tiered.rebuild(
+                            [(ck["depth"], vall)],
+                            hot_slots=self.tiered.hot_slot_budget(),
+                        )
+                        self.hstore = hashstore.DeviceHashStore.from_fps(
+                            hot
+                        )
+                    else:
+                        self.hstore = hashstore.DeviceHashStore.from_fps(
+                            vall
+                        )
                     ck["visited"] = jnp.full((64,), SENT, U64)
             frontier, visited = ck["frontier"], ck["visited"]
             n_f, distinct, generated = ck["n_f"], ck["distinct"], ck["generated"]
@@ -3506,7 +3755,7 @@ class JaxChecker:
                     ent = getattr(self, "_presize_entries", 0)
                     if ent:
                         try:
-                            self.hstore.reserve(int(ent * 1.1))
+                            self._tier_reserve(int(ent * 1.1))
                         except Exception as e:  # graftlint: waive[GL003]
                             # a failed presize reserve degrades like any
                             # other grow failure (reserve() only grows)
@@ -3532,6 +3781,13 @@ class JaxChecker:
                 self._submit_prewarm(
                     level_sizes, distinct, max_depth, frontier, visited
                 )
+            # --- tiered drain: a slab left over-budget (transient
+            # soft-seat, MIN_CAP floor) or whose next growth would bust
+            # the budget demotes HERE, between levels, where no redo is
+            # ever needed — and this is the superstep windows' only
+            # drain site (their commit path adopts without the staged
+            # between-level grow) ----------------------------------------
+            self._tier_drain(depth, n_f)
             # --- multi-level resident superstep: up to N fused levels
             # in ONE device program + ONE ledgered ring fetch
             # (engine/superstep.py).  A stopped level (abort /
@@ -3688,14 +3944,33 @@ class JaxChecker:
                     if flags & graft_superstep.FLAG_OVF_SLAB:
                         self._hs_pending = None
                         try:
-                            self.hstore.grow()
+                            how = self._slab_grow_or_demote(
+                                depth + 1, expected=max(n_f, 1)
+                            )
                         except Exception as e:  # graftlint: waive[GL003]
                             # grow failure degrades to the sort path
                             # like every other grow site
                             visited = self._degrade_hashstore(e)
                         else:
                             self._mega_stats["redo_slab"] += 1
-                            graft_obs.grow("slab", self.hstore.cap)
+                            if how == "demoted":
+                                # FLAG_OVF_SLAB_TIER: the host
+                                # reclassified the stop — the grow the
+                                # device asked for would bust the tier
+                                # budget, so it demoted instead and the
+                                # stopped level replays per-level (the
+                                # span stands down to 1 from here on)
+                                flags |= (
+                                    graft_superstep.FLAG_OVF_SLAB_TIER
+                                )
+                                self._ss_stats["tier_stops"] = (
+                                    self._ss_stats.get("tier_stops", 0)
+                                    + 1
+                                )
+                                self.tiered.stats["tier_redos"] += 1
+                                graft_obs.redo("slab_tier")
+                            else:
+                                graft_obs.grow("slab", self.hstore.cap)
                     if (flags & graft_superstep.FLAG_OVF_M
                             and self.cap_m < self.kern.uni.M):
                         # mirror the per-level cap_m redo (widen + re-
@@ -3769,16 +4044,23 @@ class JaxChecker:
                 if overflow_h:
                     # a probe window filled: rehash into a bigger slab and
                     # redo against the ORIGINAL slab (the pending update
-                    # is discarded — the kernels are functional)
+                    # is discarded — the kernels are functional); under
+                    # the tiered budget the grow becomes a generation
+                    # demotion ("demote, then redo") instead
                     self._hs_pending = None
                     try:
-                        self.hstore.grow()
+                        how = self._slab_grow_or_demote(
+                            depth + 1, expected=max(n_f, n_new)
+                        )
                     except Exception as e:  # graftlint: waive[GL003]
                         # any grow failure (device OOM, injected fault)
                         # degrades to the sort path — never mid-run death
                         visited = self._degrade_hashstore(e)
                     else:
-                        graft_obs.grow("slab", self.hstore.cap)
+                        if how == "demoted":
+                            self.tiered.stats["tier_redos"] += 1
+                        else:
+                            graft_obs.grow("slab", self.hstore.cap)
                 if overflow:
                     # half-step growth ({2^k, 3*2^(k-1)}): a doubled cap_x
                     # inflates every downstream lane count (group filter,
@@ -3865,9 +4147,13 @@ class JaxChecker:
                         new_payload[: n_slices * sl] % K
                     ).astype(slot_jdt)
                     tree = [pidx32, slot16]
-                    if checkpoint_dir and checkpoint_every:
+                    if (checkpoint_dir and checkpoint_every) or (
+                        self._tier_active()
+                    ):
                         # the delta record's fps (pow2-quantized device
-                        # slice, host trim — see the checkpoint block)
+                        # slice, host trim — see the checkpoint block);
+                        # the tiered level tail needs them host-side
+                        # regardless (the generation probe's input)
                         w_ck = min(new_fps.shape[0],
                                    max(_pow2(n_new), self.chunk))
                         tree.append(new_fps[:w_ck])
@@ -3879,6 +4165,65 @@ class JaxChecker:
                     if b >= 0:
                         bad_idx = si * sl + int(b)
                         break
+            # --- tiered level tail: probe the demoted generations -------
+            # The fused/staged hot-slab probe can mistake a demoted
+            # fingerprint's revisit for fresh; the generation probe
+            # (sieve -> warm -> cold, store/tiered.py) finds exactly
+            # those rows and ONE small compaction program drops them
+            # from the materialized frontier — counts stay bit-identical
+            # to the uncapped run.  The hit fps were re-inserted into
+            # the hot slab by the very probe that admitted them: that
+            # is the re-heat, so the next revisit resolves on device.
+            n_new_store = n_new  # kernel-fresh (= hot-slab delta) count
+            fps_np_lvl = None    # host-side POST-filter level fps
+            tier_traced = False  # pidx/slot already host-filtered here
+            if self._tier_active() and n_new:
+                if mres is not None:
+                    fps_pre = np.asarray(mres["fps"], np.uint64)
+                else:
+                    h = tail.get()
+                    fps_pre = np.asarray(h[2])[:n_new].astype(np.uint64)
+                n_keep, tier_keep, new_frontier = self._tier_filter_level(
+                    depth, n_new, fps_pre,
+                    new_frontier, new_frontier.voted_for.shape[0],
+                )
+                if tier_keep is None:
+                    fps_np_lvl = fps_pre[:n_new]
+                else:
+                    fps_np_lvl = fps_pre[:n_new][tier_keep]
+                    if mres is not None:
+                        pidx_np = pidx_np[tier_keep]
+                        slot_np = slot_np[tier_keep]
+                        mres["fps"] = fps_np_lvl
+                    else:
+                        pidx_np = np.asarray(
+                            h[0]
+                        )[:n_new].astype(np.int64)[tier_keep]
+                        slot_np = np.asarray(
+                            h[1]
+                        )[:n_new].astype(np.int64)[tier_keep]
+                        tier_traced = True
+                    if bad_idx >= 0:
+                        # a violating row is truly new by construction
+                        # (its FIRST visit is where the invariant scan
+                        # sees it; generation members were scanned clean
+                        # at theirs) — remap past the dropped revisits
+                        assert tier_keep[bad_idx], (
+                            "invariant violation attributed to an "
+                            "already-visited (generation) row"
+                        )
+                        bad_idx = int(np.count_nonzero(tier_keep[:bad_idx]))
+                    n_new = n_keep
+                if n_new == 0:
+                    # every fresh lane was a generation revisit: this IS
+                    # the uncapped run's fixpoint level — adopt the slab
+                    # (the re-heats stay hot; its count is the KERNEL
+                    # fresh count) and stop exactly like the n_new == 0
+                    # break above (mult already added, no delta record)
+                    self.hstore.adopt(self._hs_pending, n_new_store)
+                    self._hs_pending = None
+                    n_f = 0
+                    break
             # the audit re-expands sampled rows from their PARENTS, so
             # the pre-swap frontier must outlive the swap (audit runs
             # only; production keeps the old drop-at-swap lifetime)
@@ -3902,25 +4247,45 @@ class JaxChecker:
                 # and grow BETWEEN levels when the next level's worst
                 # case (~2x this one) would cross the 1/2 load line, so
                 # mid-level overflow redos stay the rare backstop
-                self.hstore.adopt(self._hs_pending, n_new)
+                # adopt the KERNEL-fresh count: under the tiered store
+                # the slab also re-heated this level's generation
+                # revisits, so its occupancy delta is n_new_store, not
+                # the post-filter n_new the distinct counter takes
+                self.hstore.adopt(self._hs_pending, n_new_store)
                 self._hs_pending = None
                 if mres is not None:
                     # free conservation check: the fused program counted
                     # the pending slab's live slots in its control
                     # vector — they must equal the distinct set exactly
+                    # (or, once generations exist, the hot-tier count
+                    # the engine tracks insert-exactly)
                     resilience.integrity.occupancy_check(
-                        "device hash slab", mres["slab_live"], distinct,
+                        "device hash slab", mres["slab_live"],
+                        self.hstore.count if self._tier_active()
+                        else distinct,
                         level=depth,
                     )
-                if self.hstore.need_grow(extra=2 * n_new):
+                if self.hstore.need_grow(extra=2 * n_new) or (
+                    self._tier_on() and self.hstore.count > 0
+                    and not self.tiered.slab_fits(self.hstore.cap)
+                ):
                     try:
-                        self.hstore.grow()
+                        # the between-level grow: under the tiered
+                        # budget this is the COMMON demotion site (no
+                        # redo needed — the level is already committed);
+                        # it also DRAINS a soft over-budget slab left by
+                        # a level whose fresh set alone exceeded the hot
+                        # budget (seated transiently, demoted here)
+                        how = self._slab_grow_or_demote(
+                            depth, expected=2 * n_new
+                        )
                     except Exception as e:  # graftlint: waive[GL003]
                         # grow failure degrades to the sort path (the
                         # adopted slab holds the full visited set)
                         visited = self._degrade_hashstore(e)
                     else:
-                        graft_obs.grow("slab", self.hstore.cap)
+                        if how == "grew":
+                            graft_obs.grow("slab", self.hstore.cap)
             elif self.host_store is None:
                 # merge, then trim the store to a pow4 capacity >= distinct;
                 # new_fps is survivor-compacted, so slicing keeps every
@@ -3934,10 +4299,11 @@ class JaxChecker:
                 visited = _merge_sorted(visited, new_fps[:w])[
                     : max(_cap4(distinct + 1), self._presize_vcap)
                 ]
-            if mres is None and pay_host is None:
+            if mres is None and pay_host is None and not tier_traced:
                 # level tail boundary: everything after this needs the
                 # trace arrays host-side (window 0 already fetched them
-                # at submit, serially)
+                # at submit, serially; the tiered correction above may
+                # have consumed + filtered them already)
                 h = tail.get()
                 pidx_np = np.asarray(h[0])[:n_new].astype(np.int64)
                 slot_np = np.asarray(h[1])[:n_new].astype(np.int64)
@@ -4019,7 +4385,9 @@ class JaxChecker:
             # --- sampled recomputation audit (BEFORE the level's delta
             # record commits: a caught level never enters the log) -----
             if self.audit and n_new:
-                if mres is not None:
+                if fps_np_lvl is not None:
+                    level_fps_ref = fps_np_lvl
+                elif mres is not None:
                     level_fps_ref = mres["fps"]
                 elif fps_host is not None:
                     level_fps_ref = fps_host
@@ -4053,7 +4421,11 @@ class JaxChecker:
                 # level — latent under the sorted store (its per-level
                 # capacity steps declared shape events that excused the
                 # compile), surfaced by the hash slab's constant shape
-                if mres is not None:
+                if fps_np_lvl is not None:
+                    # the tiered correction already holds the exact
+                    # post-filter level fps host-side
+                    fps_np = fps_np_lvl
+                elif mres is not None:
                     # the fused program's one control fetch carried them
                     fps_np = mres["fps"]
                 elif fps_host is not None:
@@ -4082,9 +4454,13 @@ class JaxChecker:
                     # slab-occupancy conservation check at the dump
                     # cadence: the snapshot about to be trusted by a
                     # future resume must count exactly the distinct set
+                    # (the hot-tier count once generations exist — a
+                    # tiered resume rebuilds from the log regardless)
                     resilience.integrity.occupancy_check(
                         "device hash slab", self.hstore.occupancy(),
-                        distinct, level=depth,
+                        self.hstore.count if self._tier_active()
+                        else distinct,
+                        level=depth,
                     )
                     self.hstore.dump(
                         os.path.join(checkpoint_dir, "hslab.npz"),

@@ -92,6 +92,15 @@ FLAG_OVF_M = 4      # a child overflowed the cap_m msg-id width
 FLAG_OVF_OUT = 8    # n_new > cap_f (cannot seat the next frontier)
 FLAG_ABORT = 16     # split-brain abort in the stopped level
 FLAG_BAD = 32       # invariant violation in the stopped level
+# host-synthesized refinement of FLAG_OVF_SLAB (never set on device:
+# the device reports slab PRESSURE; the budget is host policy): the
+# grow the stop asked for would exceed the tiered store's device
+# budget, so the host DEMOTES a generation instead of growing and the
+# stopped level replays per-level against the drained slab — "demote,
+# then redo" where the untiered path would "grow or die"
+# (store/tiered.py; once a generation exists, supersteps stand down to
+# span 1 — the resident loop cannot host-correct mid-window)
+FLAG_OVF_SLAB_TIER = 64
 
 # stop reasons: RUN means the while_loop exhausted its span — every
 # level committed clean (the steady state).  STOP marks an uncommitted

@@ -46,6 +46,10 @@ Event taxonomy (docs/OBSERVABILITY.md):
 ``skew``          per-owner straggler skew of one mesh level
 ``shape``         a declared recompile cause (capacity/shape event)
 ``integrity``     a conservation/audit fail-stop fired
+``tier_demote``   one hot-slab generation demotion (tiered store):
+                  ``level``, ``n`` fps, ``gen`` id, ``s``, ``cold``
+``tier_probe``    one warm/cold generation probe: ``level``, ``lanes``,
+                  ``hits``, ``s`` wait (the spill-overlap metric)
 ================  ======================================================
 """
 
@@ -274,6 +278,15 @@ class TelemetryHub:
         self.exchange_bytes = 0
         self.exchange_raw_bytes = 0
         self.integrity_failures = 0
+        # tiered visited store (store/tiered.py): demotions + per-tier
+        # probe accounting — probe-wait vs level wall is the
+        # spill-overlap acceptance metric (docs/PERF.md)
+        self.tier_demotions = 0
+        self.tier_spilled = 0
+        self.tier_probes = 0
+        self.tier_probe_lanes = 0
+        self.tier_probe_hits = 0
+        self.tier_probe_wait_s = 0.0
         self.slab_cap = 0
         self.distinct = 0
         self._last_boundary = self._t_off
@@ -404,6 +417,14 @@ class TelemetryHub:
             self.exchange_raw_bytes += int(doc.get("raw") or 0)
         elif ev == "integrity":
             self.integrity_failures += 1
+        elif ev == "tier_demote":
+            self.tier_demotions += 1
+            self.tier_spilled += int(doc.get("n") or 0)
+        elif ev == "tier_probe":
+            self.tier_probes += 1
+            self.tier_probe_lanes += int(doc.get("lanes") or 0)
+            self.tier_probe_hits += int(doc.get("hits") or 0)
+            self.tier_probe_wait_s += float(doc.get("s") or 0.0)
         elif ev == "run_begin":
             self._last_boundary = t
 
@@ -452,6 +473,15 @@ class TelemetryHub:
             if self.exchange_bytes or self.exchange_raw_bytes:
                 out["exchange_bytes"] = self.exchange_bytes
                 out["exchange_raw_bytes"] = self.exchange_raw_bytes
+            if self.tier_demotions or self.tier_probes:
+                out["tiered"] = dict(
+                    demotions=self.tier_demotions,
+                    spilled=self.tier_spilled,
+                    probes=self.tier_probes,
+                    probe_lanes=self.tier_probe_lanes,
+                    probe_hits=self.tier_probe_hits,
+                    probe_wait_s=round(self.tier_probe_wait_s, 6),
+                )
             if self.slab_cap:
                 out["slab_cap"] = self.slab_cap
                 out["slab_load"] = round(
@@ -595,3 +625,18 @@ def integrity(what: str) -> None:
     hub = CURRENT
     if hub is not None:
         hub.emit("integrity", what=what)
+
+
+def tier_demote(level, n, gen, seconds, cold: bool = False) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("tier_demote", level=level, n=n, gen=gen,
+                 s=round(seconds, 6), cold=cold)
+
+
+def tier_probe(level, lanes, hits, sieve: int = 0,
+               wait_s: float = 0.0) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("tier_probe", level=level, lanes=lanes, hits=hits,
+                 sieve=sieve, s=round(wait_s, 6))

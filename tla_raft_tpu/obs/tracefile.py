@@ -22,6 +22,8 @@ tid    track               events
 7      grow/redo           instants (named budget)
 8      watchdog/audit      instants (arm/trip, audit, retire,
                            integrity)
+9      tiered store        ``X`` slices per demotion and per warm/cold
+                           generation probe (spill-overlap readout)
 =====  ==================  ==========================================
 
 Timestamps are microseconds on the hub's monotonic clock, so every
@@ -46,6 +48,7 @@ TRACKS = {
     6: "compile",
     7: "grow/redo",
     8: "watchdog/audit",
+    9: "tiered store",
 }
 
 
@@ -130,6 +133,17 @@ def to_chrome_trace(events: list[dict]) -> dict:
         elif kind == "watchdog_trip":
             ev("i", 8, f"WATCHDOG TRIP ({doc.get('stage')})", t,
                args=dict(ctx=doc.get("ctx")))
+        elif kind == "tier_demote":
+            s = float(doc.get("s") or 0.0)
+            ev("X", 9, f"demote gen {doc.get('gen')}", t - s, dur=s,
+               args=dict(level=doc.get("level"), n=doc.get("n"),
+                         cold=doc.get("cold")))
+        elif kind == "tier_probe":
+            s = float(doc.get("s") or 0.0)
+            ev("X", 9, "gen probe", t - s, dur=s,
+               args=dict(level=doc.get("level"),
+                         lanes=doc.get("lanes"),
+                         hits=doc.get("hits")))
         elif kind in ("audit", "retire", "integrity", "shape",
                       "exchange", "skew"):
             ev("i", 8, kind, t, args={

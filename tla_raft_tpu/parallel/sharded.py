@@ -268,6 +268,7 @@ class ShardedChecker:
         pipeline_window: int | None = None,
         use_mxu: bool | None = None,
         watchdog=None,
+        warm_bytes: int | None = None,
     ):
         assert exchange in ("all_to_all", "all_gather")
         # async intra-level pipeline (engine/pipeline.py): the level's
@@ -341,6 +342,14 @@ class ShardedChecker:
                 raise ValueError("host_store_dir requires canon='late'")
         self.host_store_dir = host_store_dir
         self.host_stores = None  # built lazily in run()
+        # host-RAM budget for the WARM tier, split across the D
+        # per-owner stores: each shard buffers warm_bytes/D in RAM and
+        # spills sorted runs (the cold generations of the mesh paths,
+        # partition-tagged by their shard directory = fp % D) to disk
+        # past it — an elastic D -> D' resume rebuilds them from the
+        # mdelta replay under the new owner map (store/tiered.py
+        # repartition is the same move applied to raw runs)
+        self.warm_bytes = warm_bytes
         # canon="late" (default): guards-only expand, then materialize +
         # full-state-fingerprint only the compacted candidates — no
         # P-sized per-lane intermediates and no per-state msum carried in
@@ -383,6 +392,13 @@ class ShardedChecker:
         self.skew = resilience.integrity.SkewMeter(self.D)
         # per-level hang watchdog (resilience/elastic.py); None = off
         self.watchdog = watchdog
+
+    def _store_budget_entries(self) -> int:
+        """Per-owner in-RAM entry budget of the external stores (0 =
+        the native default): --warm-bytes split across the D shards."""
+        if not self.warm_bytes:
+            return 0
+        return max(int(self.warm_bytes) // 8 // self.D, 1)
 
     def _legacy_run_fps(self) -> tuple[str, ...]:
         """Pre-elastic run fingerprints of THIS semantic run: the old
@@ -826,6 +842,8 @@ class ShardedChecker:
         self.meter.end_level()
         verdict = np.zeros((D, D * cap_r), bool)
         n_new = 0
+        n_uniq = 0
+        t_probe = time.monotonic()
         for o in range(D):
             order = np.lexsort((rp[o], rf[o], rv[o]))
             sv = rv[o][order]
@@ -839,6 +857,14 @@ class ShardedChecker:
             vs[first] = is_new
             verdict[o][order] = vs
             n_new += int(is_new.sum())
+            n_uniq += len(uniq)
+        if any(s.num_runs for s in self.host_stores):
+            # the per-owner stores hold spilled (disk) runs: publish
+            # the warm/cold probe wait of this level's verdicts
+            graft_obs.tier_probe(
+                len(self.meter.levels), n_uniq, n_uniq - n_new,
+                wait_s=time.monotonic() - t_probe,
+            )
         return verdict.reshape(D, D, cap_r), n_new
 
     @functools.cached_property
@@ -1779,7 +1805,19 @@ class ShardedChecker:
             # one degraded host/disk path)
             insert_secs[o] = time.monotonic() - t_o
 
+        t_probe = time.monotonic()
         list(self._io_pool.map(insert_one, range(D)))
+        if any(s.num_runs for s in self.host_stores):
+            # spilled membership: the per-owner stores hold disk runs,
+            # so this level's insert verdicts probed the warm/cold
+            # tiers — publish the wall elapsed around the concurrent
+            # map (NOT the per-owner sum, which overstates a parallel
+            # stall by up to D), the spill-overlap acceptance metric
+            graft_obs.tier_probe(
+                depth + 1, int(n_us.sum()),
+                int(n_us.sum()) - int(inserted.sum()),
+                wait_s=time.monotonic() - t_probe,
+            )
         meter.note_packed(packed_ok)
         meter.add(host_bytes=fetch_bytes + D * vq + 16 * D)
         vb = jax.device_put(jnp.asarray(bits_np.reshape(-1)), shard)
@@ -1923,7 +1961,8 @@ class ShardedChecker:
 
             self.host_stores = [
                 HostFPStore(
-                    os.path.join(self.host_store_dir, f"shard_{o:02d}")
+                    os.path.join(self.host_store_dir, f"shard_{o:02d}"),
+                    mem_budget_entries=self._store_budget_entries(),
                 )
                 for o in range(D)
             ]
@@ -2749,7 +2788,10 @@ class ShardedChecker:
             from ..native import HostFPStore
 
             self.host_stores = [
-                HostFPStore(os.path.join(self.host_store_dir, f"shard_{o:02d}"))
+                HostFPStore(
+                    os.path.join(self.host_store_dir, f"shard_{o:02d}"),
+                    mem_budget_entries=self._store_budget_entries(),
+                )
                 for o in range(D)
             ]
             if resume_from is None:

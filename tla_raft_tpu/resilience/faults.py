@@ -60,6 +60,10 @@ FAULT_SITES = {
     "sieve.commit": "sieve-slab snapshot: renamed, not manifested",
     "monolith.tmp": "monolith snapshot: tmp written, not renamed",
     "monolith.commit": "monolith snapshot: renamed, not manifested",
+    "gen.tmp": "tiered-store generation run: tmp written, not renamed "
+               "(a kill mid-demotion; resume rebuilds every tier from "
+               "the delta log)",
+    "gen.commit": "tiered-store generation run: renamed, not manifested",
     "base.commit": "base monolith copied into a delta dir, not manifested",
     "manifest.commit": "manifest json: tmp written, not renamed",
     "hashstore.grow": "the Nth visited-slab grow/rehash",

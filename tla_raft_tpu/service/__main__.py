@@ -67,6 +67,14 @@ def _cmd_submit(args) -> int:
         options["chunk"] = args.chunk
     if args.backend != "jax":
         options["backend"] = args.backend
+    if args.dev_bytes:
+        # tiered job: the worker runs it with a hot-slab device budget
+        # (store/tiered.py) — the scheduler can pack configs whose
+        # visited sets exceed HBM; they route sequential (the batched
+        # bucket core shares ONE slab across tenants)
+        options["dev_bytes"] = int(args.dev_bytes)
+    if args.warm_bytes:
+        options["warm_bytes"] = int(args.warm_bytes)
     jids = []
     for _ in range(args.count):
         jids.append(
@@ -250,6 +258,13 @@ def main(argv=None) -> int:
                              "legacy-append", "become-follower"))
     ps.add_argument("--no-symmetry", action="store_true")
     ps.add_argument("--no-view", action="store_true")
+    ps.add_argument("--dev-bytes", type=float, default=None,
+                    help="device-memory budget for the job's hot "
+                         "visited tier: lets the scheduler pack "
+                         "configs whose visited sets exceed HBM "
+                         "(tiered store — the job runs sequentially)")
+    ps.add_argument("--warm-bytes", type=float, default=None,
+                    help="host-RAM budget for the job's warm tier")
     ps.add_argument("--chunk", type=int, default=None,
                     help="sequential-path chunk override")
     ps.add_argument("--count", type=int, default=1,

@@ -109,6 +109,7 @@ class Scheduler:
             jobs_done=0, jobs_failed=0, buckets=0, batched_jobs=0,
             sequential_jobs=0, max_bucket=0, dispatches=0, programs=0,
             recovered=0, config_dispatch_weight=0, poisoned=0,
+            tiered_jobs=0,
         )
         # service metrics registry (obs/metrics.py): snapshots commit
         # atomically to <root>/metrics.json after every scheduler pass
@@ -130,6 +131,13 @@ class Scheduler:
             opt.get("backend", "jax") == "jax"
             and not opt.get("mesh")
             and not opt.get("fpstore_dir")
+            # tiered jobs (a declared device-memory budget) run
+            # sequentially: the batched bucket core shares ONE hash
+            # slab across tenants, which a per-job hot budget cannot
+            # partition — the scheduler still packs them into the same
+            # queue, so configs whose visited sets exceed HBM flow
+            # through the service like any other job
+            and not opt.get("dev_bytes")
         )
 
     def plan(self, job_ids: list[str]):
@@ -267,6 +275,14 @@ class Scheduler:
                     fpstore_dir=opt.get("fpstore_dir"),
                     mesh_deep=bool(opt.get("mesh_deep", False)),
                     use_mxu=self.use_mxu,
+                    dev_bytes=(
+                        int(opt["dev_bytes"])
+                        if opt.get("dev_bytes") else None
+                    ),
+                    warm_bytes=(
+                        int(opt["warm_bytes"])
+                        if opt.get("warm_bytes") else None
+                    ),
                 )
         except resilience.Preempted:
             self.q.release(jid, note="preempted mid-job")
@@ -285,6 +301,8 @@ class Scheduler:
             return
         self.q.complete(jid, summary_public(summary))
         self.stats["sequential_jobs"] += 1
+        if opt.get("dev_bytes"):
+            self.stats["tiered_jobs"] += 1
         self.stats["jobs_done" if summary["ok"] else "jobs_failed"] += 1
 
     # -- metrics -------------------------------------------------------
@@ -313,7 +331,7 @@ class Scheduler:
         )
         for k in ("jobs_done", "jobs_failed", "poisoned", "buckets",
                   "batched_jobs", "sequential_jobs", "dispatches",
-                  "programs", "recovered"):
+                  "programs", "recovered", "tiered_jobs"):
             m.counter(k).set(self.stats[k])
         try:
             m.commit(self.q.root)

@@ -1,0 +1,17 @@
+"""Visited-store tier structures (device-external).
+
+``store.tiered`` holds the HBM-hot / host-warm / disk-cold visited
+tiers (docs/PERF.md "Tiered visited store"); the device-resident hot
+slab itself lives in ``ops/hashstore.py`` and stays owned by the
+engines.  Import is device-free (GL001) — the one device kernel here
+imports jax lazily.
+"""
+
+from .tiered import (  # noqa: F401
+    TieredVisitedStore,
+    drop_rows,
+    repartition,
+    store_bytes_from_env,
+    sweep_gens,
+    warm_bytes_from_env,
+)
