@@ -114,6 +114,34 @@ GOLDEN_LEVELS = {
 MAX_INIT_ATTEMPTS = int(os.environ.get("BENCH_INIT_ATTEMPTS", "3"))
 
 
+def _append_trend(record: dict, bench_out: str) -> None:
+    """Fold this round's BENCH_OUT record into the docs/bench/ trend
+    series (obs/trend.py) so the perf trajectory grows as a side
+    effect of running the bench.  The round comes from the BENCH_OUT
+    name (BENCH_rNN.json) or BENCH_ROUND; without either the record
+    stays out of the series (a one-off probe run, not a round)."""
+    try:
+        from tla_raft_tpu.obs import trend as obs_trend
+
+        rnd = obs_trend.round_from_name(bench_out)
+        if rnd is None and os.environ.get("BENCH_ROUND"):
+            rnd = int(os.environ["BENCH_ROUND"])
+        if rnd is None:
+            return
+        bench_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "docs", "bench"
+        )
+        path = obs_trend.append_record(
+            record, bench_dir, round_no=rnd,
+            source=os.path.basename(bench_out),
+        )
+        if path:
+            print(f"[bench] trend record -> {path}", file=sys.stderr)
+    except Exception as e:  # graftlint: waive[GL003] — the trend
+        # series is bookkeeping; it must never fail the bench run
+        print(f"[bench] trend append failed: {e}", file=sys.stderr)
+
+
 def _emit_failure(failure_class: str, exc: BaseException, **extra) -> None:
     import traceback
 
@@ -397,6 +425,7 @@ def _bench_service(jax) -> int:
         with open(tmp, "w") as f:
             json.dump(record, f, indent=1)
         os.replace(tmp, bench_out)
+        _append_trend(record, bench_out)
     if not keep_root:
         shutil.rmtree(base, ignore_errors=True)
     return 0 if parity else 1
@@ -909,6 +938,7 @@ def main():
         with open(tmp, "w") as f:
             json.dump(record, f, indent=1)
         os.replace(tmp, bench_out)
+        _append_trend(record, bench_out)
     # parity None = advisory-only disagreement (indeterminate): exit 0
     # so a single-source row can never fail a correct chip run
     return 1 if parity is False else 0

@@ -34,6 +34,7 @@ MODULES = [
     "tla_raft_tpu.obs.tracefile",
     "tla_raft_tpu.obs.progress",
     "tla_raft_tpu.obs.metrics",
+    "tla_raft_tpu.obs.trend",
     "tla_raft_tpu.store",
     "tla_raft_tpu.store.tiered",
 ]

@@ -18,7 +18,7 @@ import json
 import os
 import sys
 
-from . import ast_lint, dispatch_audit, jaxpr_audit
+from . import ast_lint, cost_audit, dispatch_audit, jaxpr_audit
 
 
 def main(argv=None) -> int:
@@ -33,6 +33,10 @@ def main(argv=None) -> int:
                    help="skip the GL011 per-level dispatch-budget audit "
                         "(runs the tiny config through both level-loop "
                         "paths; needs jax)")
+    p.add_argument("--no-cost", action="store_true",
+                   help="skip the GL013 per-kernel cost/memory budget "
+                        "audit (compiles the registered kernels at the "
+                        "tiny reference shapes; needs jax)")
     p.add_argument("--no-baseline", action="store_true",
                    help="report baselined findings too")
     p.add_argument("--baseline", default=ast_lint.BASELINE_PATH,
@@ -87,6 +91,14 @@ def main(argv=None) -> int:
             f"{dledger['superstep']['span']}) to "
             f"{dispatch_audit.DISPATCH_LEDGER_PATH}"
         )
+        cledger = cost_audit.build_ledger()
+        cost_audit.write_golden(cledger)
+        print(
+            f"wrote {len(cledger) - 1} kernel cost/memory budgets "
+            f"({cledger['_meta']['backend']}/jax "
+            f"{cledger['_meta']['jax']}) to "
+            f"{cost_audit.COST_LEDGER_PATH}"
+        )
         return 0
     if not args.no_jaxpr:
         golden = jaxpr_audit.load_golden(args.ledger)
@@ -103,6 +115,12 @@ def main(argv=None) -> int:
         d_fail, d_warn = dispatch_audit.audit()
         failures += d_fail
         warnings += d_warn
+    if not args.no_jaxpr and not args.no_cost:
+        # GL013: per-kernel cost/memory budgets — compiled at the same
+        # tiny reference shapes the jaxpr audit traces (needs jax)
+        c_fail, c_warn = cost_audit.audit()
+        failures += c_fail
+        warnings += c_warn
 
     for f in findings:
         print(f.format())

@@ -1,0 +1,269 @@
+"""Device-cost observatory: XLA cost/memory harvesting + profiler capture.
+
+PR 11's flight recorder sees every host-side event, but the fused
+megakernel/superstep rewrites moved nearly all wall time INSIDE device
+programs the hub cannot see.  This module is the jax-side half of the
+observability stack (the obs/ package stays host-pure per GL012 and
+only renders what this module publishes):
+
+* :func:`harvest_compiled` — normalize one compiled executable's
+  ``cost_analysis()`` + ``memory_analysis()`` into the flat metric
+  dict the cost ledger (analysis/cost_audit.py, GL013), the
+  ``program_profile`` telemetry event and the ``--json`` ``hbm`` block
+  all share.
+* :func:`profile_program` — the runtime choke-point hook: at a program
+  dispatch site, lower+compile the jitted function at the live
+  argument shapes ONCE per (tag, shapes) and publish the harvest into
+  the telemetry hub.  ``lower().compile()`` populates the same
+  executable cache the subsequent call hits (the AOT-prewarm contract,
+  engine/pipeline.Prewarmer), so collection is compile-time only — no
+  extra device dispatch, no extra XLA compile, and the GL011 dispatch
+  budgets are unchanged.  With telemetry off (or
+  ``TLA_RAFT_DEVPROF=0``) the hook is one global read + one branch.
+* :class:`ProfilerCapture` — the opt-in ``--profile N`` jax-profiler
+  session: capture device traces for N dispatch windows (supersteps on
+  the fused path — one ledgered fetch per window ticks the counter via
+  :func:`profile_tick` from the pipeline's one fetch site) and write a
+  Perfetto-format device trace ``obs trace`` merges beside the host
+  lanes.
+
+Everything here degrades to a no-op on error: observability must never
+take the checker down (the same contract as the telemetry hub).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+from ..obs import telemetry as _obs
+
+# metrics the cost ledger records per kernel; the *_b entries come from
+# memory_analysis (CompiledMemoryStats), flops/bytes from cost_analysis
+METRIC_KEYS = (
+    "flops",         # model flops of one program execution
+    "bytes",         # bytes accessed (operands + outputs, XLA model)
+    "arg_b",         # argument buffer bytes
+    "out_b",         # output buffer bytes
+    "alias_b",       # donated/aliased bytes (in-place reuse)
+    "tmp_b",         # temp allocation bytes — the transient HBM cost
+    "code_b",        # generated code size
+)
+
+
+def enabled() -> bool:
+    """Runtime profiling rides the telemetry hub: a hub must be
+    installed, and ``TLA_RAFT_DEVPROF=0`` force-disables."""
+    return (
+        _obs.current() is not None
+        and os.environ.get("TLA_RAFT_DEVPROF", "1") != "0"
+    )
+
+
+def harvest_compiled(compiled) -> dict | None:
+    """Compiled executable -> the flat cost/memory metric dict.
+
+    Tolerates backends where either analysis is unimplemented (fields
+    default 0); returns None only when NOTHING could be harvested."""
+    out = dict.fromkeys(METRIC_KEYS, 0)
+    got = False
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            out["flops"] = float(ca.get("flops", 0.0) or 0.0)
+            out["bytes"] = float(ca.get("bytes accessed", 0.0) or 0.0)
+            got = True
+    except Exception:  # graftlint: waive[GL003] — cost_analysis is
+        # best-effort per backend; a NotImplemented/runtime error just
+        # means "no cost model here"
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            out["arg_b"] = int(
+                getattr(ma, "argument_size_in_bytes", 0) or 0
+            )
+            out["out_b"] = int(
+                getattr(ma, "output_size_in_bytes", 0) or 0
+            )
+            out["alias_b"] = int(
+                getattr(ma, "alias_size_in_bytes", 0) or 0
+            )
+            out["tmp_b"] = int(
+                getattr(ma, "temp_size_in_bytes", 0) or 0
+            )
+            out["code_b"] = int(
+                getattr(ma, "generated_code_size_in_bytes", 0) or 0
+            )
+            got = True
+    except Exception:  # graftlint: waive[GL003] — same best-effort
+        # contract as cost_analysis above
+        pass
+    return out if got else None
+
+
+def peak_bytes(metrics: dict) -> int:
+    """The program's peak-HBM approximation: arguments + outputs +
+    temps minus the aliased (in-place) overlap — the number the live
+    gauge charges for one in-flight program."""
+    return max(
+        0,
+        int(metrics.get("arg_b", 0)) + int(metrics.get("out_b", 0))
+        + int(metrics.get("tmp_b", 0))
+        - int(metrics.get("alias_b", 0)),
+    )
+
+
+# one profile per (tag, statics, arg avals) per process: the engines
+# dispatch the same program shape every level, the harvest runs once
+_SEEN: set = set()
+
+
+def _aval_key(args) -> tuple:
+    import jax
+
+    leaves = jax.tree.leaves(args)
+    return tuple(
+        (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", "")))
+        for x in leaves
+    )
+
+
+def reset_seen() -> None:
+    _SEEN.clear()
+
+
+def profile_program(tag: str, jitfn, *args, statics: dict | None = None,
+                    **meta) -> None:
+    """Harvest one jitted program's cost/memory ledger at the live
+    argument shapes and publish it as a ``program_profile`` event.
+
+    Call at the dispatch site BEFORE invoking ``jitfn`` — the
+    lower+compile here lands in the executable cache the call then
+    hits, so profiling on/off cannot change dispatch counts, compile
+    counts or (a fortiori) any model count.  Never raises."""
+    if not enabled():
+        return
+    try:
+        key = (tag, tuple(sorted((statics or {}).items())),
+               _aval_key(args))
+    except Exception:  # graftlint: waive[GL003] — an unhashable static
+        # must not take the dispatch site down
+        return
+    if key in _SEEN:
+        return
+    _SEEN.add(key)
+    try:
+        compiled = jitfn.lower(*args, **(statics or {})).compile()
+        metrics = harvest_compiled(compiled)
+    except Exception:  # graftlint: waive[GL003] — harvesting is
+        # observability, not correctness; the real call still runs
+        return
+    if metrics is None:
+        return
+    _obs.program_profile(
+        tag, **metrics, peak_b=peak_bytes(metrics), **meta
+    )
+
+
+# -- jax-profiler capture (--profile N) -----------------------------------
+
+PROFILE_DIRNAME = "profile"
+
+_PROFILER: "ProfilerCapture | None" = None
+
+
+def install_profiler(p: "ProfilerCapture | None") -> None:
+    global _PROFILER
+    _PROFILER = p
+
+
+def current_profiler() -> "ProfilerCapture | None":
+    return _PROFILER
+
+
+def profile_tick() -> None:
+    """One dispatch window completed (called from the pipeline's ONE
+    ledgered fetch site): advance the capture, stopping it after its
+    budgeted windows.  No-op unless a capture is live."""
+    p = _PROFILER
+    if p is not None:
+        p.tick()
+
+
+class ProfilerCapture:
+    """One ``--profile N`` device-trace capture session.
+
+    ``start()`` opens a ``jax.profiler`` trace (Perfetto output) under
+    ``<run_dir>/profile``; every :func:`profile_tick` counts one
+    dispatch window (a superstep on the fused path, a level elsewhere
+    — both complete through exactly one ledgered fetch); after
+    ``windows`` ticks the trace stops and a ``profile_end`` event
+    records where the device lanes landed for ``obs trace`` to merge.
+    Stop is idempotent and exception-safe — a profiler failure must
+    never take the run down."""
+
+    def __init__(self, run_dir: str, windows: int = 1):
+        self.trace_dir = os.path.join(run_dir, PROFILE_DIRNAME)
+        self.windows = max(1, int(windows))
+        self.done = 0
+        self.running = False
+        self.failed = False
+
+    def start(self) -> bool:
+        import jax.profiler
+
+        try:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(
+                self.trace_dir, create_perfetto_trace=True
+            )
+        except Exception:  # graftlint: waive[GL003] — a profiler that
+            # cannot start (unsupported backend, busy session) degrades
+            # to "no device lanes", not a dead run
+            self.failed = True
+            return False
+        self.running = True
+        # the begin event's hub timestamp IS the merge anchor: jax
+        # trace timestamps are microseconds from start_trace
+        _obs.profile_begin(self.trace_dir, self.windows)
+        return True
+
+    def tick(self) -> None:
+        if not self.running:
+            return
+        self.done += 1
+        if self.done >= self.windows:
+            self.stop()
+
+    def stop(self) -> None:
+        if not self.running:
+            return
+        self.running = False
+        import jax.profiler
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # graftlint: waive[GL003] — stop mirrors
+            # start's degrade-only contract
+            self.failed = True
+            return
+        _obs.profile_end(self.trace_dir, self.done)
+
+    def perfetto_traces(self) -> list[str]:
+        return find_perfetto_traces(self.trace_dir)
+
+
+def find_perfetto_traces(trace_dir: str) -> list[str]:
+    """The gzipped Perfetto traces a capture session wrote (newest
+    last — jax nests them under plugins/profile/<timestamp>/)."""
+    return sorted(
+        glob.glob(
+            os.path.join(
+                trace_dir, "plugins", "profile", "*",
+                "perfetto_trace.json.gz",
+            )
+        )
+    )

@@ -259,6 +259,11 @@ def summarize(res, chk=None, seconds: float | None = None,
         if "straggler" in out:
             tel["straggler"] = out["straggler"]
         out["telemetry"] = tel
+        if "hbm" in tel:
+            # the live device-memory gauge (registered buffers + worst
+            # program temp, vs the --dev-bytes budget) surfaces at the
+            # top level beside the counts it prices
+            out["hbm"] = tel["hbm"]
     return out
 
 
@@ -296,6 +301,7 @@ def run_check(
     audit_retries: int = 3,
     watchdog: float = 0.0,
     telemetry: bool | None = None,
+    profile: int = 0,
     dev_bytes: int | None = None,
     warm_bytes: int | None = None,
     progress=None,
@@ -363,6 +369,7 @@ def run_check(
             use_mxu=use_mxu, megakernel=megakernel,
             superstep=superstep, audit=audit,
             audit_retries=audit_retries, watchdog=watchdog,
+            profile=profile,
             dev_bytes=dev_bytes, warm_bytes=warm_bytes,
             hub=hub, progress=progress, out=out,
             install_signals=install_signals,
@@ -401,6 +408,7 @@ def _run_check_impl(
     audit,
     audit_retries,
     watchdog,
+    profile,
     dev_bytes,
     warm_bytes,
     hub,
@@ -475,11 +483,58 @@ def _run_check_impl(
             print(f"Watchdog: armed (floor {float(watchdog)}s/level)",
                   file=out)
 
+        # opt-in jax-profiler capture (--profile N, default off): the
+        # device-side twin of the flight recorder — N dispatch windows
+        # (supersteps on the fused path) traced into
+        # <run_dir>/profile/, merged beside the host lanes by
+        # `obs trace` (analysis/devprof.py)
+        prof = None
+        if profile and int(profile) > 0:
+            from .analysis import devprof as graft_devprof
+
+            prof_dir = (
+                checkpoint_dir
+                or os.environ.get("TLA_RAFT_TELEMETRY_DIR")
+            )
+            if hub is None:
+                # without the flight recorder there is no
+                # profile_begin merge anchor and no events.jsonl for
+                # `obs trace` to hang the device lanes off — a capture
+                # would be unreachable through the documented flow
+                print(
+                    "--profile needs telemetry on (the profile_begin "
+                    "event anchors the device-lane merge; flag "
+                    "ignored)", file=out,
+                )
+            elif not prof_dir:
+                print(
+                    "--profile needs --checkpoint-dir (or "
+                    "TLA_RAFT_TELEMETRY_DIR): the device trace lands "
+                    "beside events.jsonl (flag ignored)", file=out,
+                )
+            else:
+                prof = graft_devprof.ProfilerCapture(
+                    prof_dir, int(profile)
+                )
+                if prof.start():
+                    graft_devprof.install_profiler(prof)
+                    print(
+                        f"Profiler: capturing {prof.windows} dispatch "
+                        f"window(s) -> {prof.trace_dir}", file=out,
+                    )
+                else:
+                    prof = None
+
         def wd_teardown():
             # on EVERY exit (Preempted, device loss, IntegrityError
             # propagate to the caller by contract): a leaked watchdog
             # thread would park forever and a stale global would
             # swallow the next run's heartbeats
+            if prof is not None:
+                from .analysis import devprof as graft_devprof
+
+                prof.stop()
+                graft_devprof.install_profiler(None)
             if wd is not None:
                 wd.cancel()
                 resilience.elastic.install_watchdog(None)
@@ -819,6 +874,15 @@ def main(argv=None) -> int:
                         "on; 0 disables.  Host-side only — counts and "
                         "dispatch/fetch budgets are identical either "
                         "way.  env: TLA_RAFT_TELEMETRY")
+    p.add_argument("--profile", type=int, default=0, metavar="N",
+                   help="opt-in device profiler: capture jax.profiler "
+                        "traces for the first N dispatch windows "
+                        "(supersteps on the fused path) into "
+                        "<checkpoint-dir>/profile/, then `python -m "
+                        "tla_raft_tpu.obs trace` merges the device "
+                        "lanes into trace.json beside the host lanes. "
+                        "Default off; counts are bit-identical either "
+                        "way")
     p.add_argument("--progress", action="store_true",
                    help="live one-line progress display (states/s, "
                         "frontier, slab load, levels/dispatch, "
@@ -959,6 +1023,7 @@ def main(argv=None) -> int:
             telemetry=(
                 None if args.telemetry is None else bool(args.telemetry)
             ),
+            profile=args.profile,
             dev_bytes=(
                 int(args.dev_bytes) if args.dev_bytes else None
             ),

@@ -76,6 +76,8 @@ from ..store import tiered as graft_tiered
 from . import megakernel as graft_megakernel
 from . import superstep as graft_superstep
 from . import pipeline as graft_pipeline
+from ..analysis import devprof as graft_devprof
+from . import forecast as graft_forecast
 from .forecast import MIN_LEVELS as PRESIZE_MIN_LEVELS, pow2ceil as _pow2
 from .invariants import resolve_invariant_kernel
 
@@ -1166,6 +1168,14 @@ class JaxChecker:
             self._mega_prog = graft_megakernel.level_program_for(
                 self, self._mega_donate
             )
+            # device-cost observatory: harvest the fused program's XLA
+            # cost/memory ledger once per shape (compile-time only —
+            # the lower+compile lands in the cache this call then hits)
+            graft_devprof.profile_program(
+                "megakernel.level", self._mega_prog,
+                frontier, self.hstore.slab, n_f_dev,
+                statics=dict(cap_out=cap_out),
+            )
             outs = self._mega_prog(
                 frontier, self.hstore.slab, n_f_dev, cap_out=cap_out
             )
@@ -1378,9 +1388,22 @@ class JaxChecker:
             graft_sanitize.note_shape_event(f"superstep shapes {skey}")
             self._ss_sig = skey
         graft_sanitize.superstep_begin()
+        # live-HBM gauge: the trace-spool ring (fps u64 + pidx u32 +
+        # slot u16/u32 per entry) is the superstep's one extra
+        # long-lived buffer
+        graft_obs.buffer(
+            "ring", ring * (12 + (2 if self.K <= 0xFFFF else 4))
+        )
+        n_f_dev = jnp.asarray(n_f, I64)
+        span_dev = jnp.asarray(span, I64)
+        # device-cost observatory (see the megakernel site)
+        graft_devprof.profile_program(
+            "superstep.levels", prog,
+            frontier, self.hstore.slab, n_f_dev, span_dev,
+            statics=dict(cap_f=cap_f, ring=ring),
+        )
         outs = prog(
-            frontier, self.hstore.slab, jnp.asarray(n_f, I64),
-            jnp.asarray(span, I64),
+            frontier, self.hstore.slab, n_f_dev, span_dev,
             cap_f=cap_f, ring=ring,
         )
         (fr_out, slab_out, ctrl_d, mn_d, mm_d, rf_d, rp_d,
@@ -1795,6 +1818,73 @@ class JaxChecker:
             # forecast floor: pow2 and >= chunk, so still a chunk multiple
             c = self._presize_fcap
         return c
+
+    def _hbm_note(self, frontier, level_sizes, max_depth,
+                  depth) -> None:
+        """Live-HBM gauge + predictive pre-OOM forecast (loop-top, one
+        per level, telemetry-gated to a single global read when off).
+
+        Registers the frontier's live device bytes beside the slab the
+        hash store already registers; under a device budget
+        (``--dev-bytes`` hot-tier, or the ``TLA_RAFT_DEV_BYTES`` paging
+        budget) it also forecasts the NEXT level's working set — the
+        slab after its forecast inserts, its quantized frontier, and
+        the expand lane transient — and emits ``pre_oom_forecast``
+        when that would bust the budget: the predictive twin of the
+        reactive overflow-redo (the tier/pager still handles the real
+        crossing; this event is the early warning --progress and the
+        service can act on)."""
+        if graft_obs.current() is None:
+            return
+        nbytes = 0
+        for x in jax.tree.leaves(frontier):
+            it = getattr(getattr(x, "dtype", None), "itemsize", None)
+            nbytes += int(getattr(x, "size", 0)) * int(it or 0)
+        graft_obs.buffer("frontier", nbytes)
+        budget = (
+            self.tiered.dev_bytes if self.tiered is not None
+            else (self.store_bytes or self.dev_budget)
+        )
+        if not budget:
+            return
+        if not getattr(self, "_hbm_budget_noted", False):
+            self._hbm_budget_noted = True
+            graft_obs.hbm_budget(budget)
+        cap_f = getattr(
+            getattr(frontier, "voted_for", None), "shape", (0,)
+        )[0]
+        if not cap_f or getattr(self, "_pre_oom_level", None) == depth:
+            return  # segmented external frontier (already paged) / dup
+        fut = graft_forecast.forecast_new_states(
+            level_sizes, max_depth
+        )[:1]
+        if not fut:
+            return
+        nrows = int(fut[0])
+        row_b = max(nbytes // max(cap_f, 1), 1)
+        cap_next = self._frontier_cap(int(nrows * 1.25) + 1)
+        slab_b = 0
+        if self.use_hashstore and self.hstore is not None:
+            want = hashstore.slab_rows(self.hstore.count + nrows)
+            if self.tiered is not None:
+                # the tier demotes rather than grow past the budget:
+                # charge the hot slab at its budget-clamped size
+                want = min(want, max(
+                    hashstore.slab_rows(
+                        self.tiered.max_hot_entries or 1
+                    ), hashstore.MIN_CAP,
+                ))
+            slab_b = want * 8
+        # expand transient: cv/cf u64 + cp i64 per candidate lane
+        lanes_b = (cap_next // self.chunk) * self.cap_x * 24
+        need = slab_b + cap_next * row_b + lanes_b
+        if need > budget:
+            self._pre_oom_level = depth
+            graft_obs.pre_oom(
+                depth + 1, need, budget,
+                slab=slab_b, frontier=cap_next * row_b,
+                lanes=lanes_b, rows=nrows,
+            )
 
     def _update_presize(self, level_sizes, distinct, max_depth, frontier):
         """Ratchet the forecast capacity floors (see __init__).
@@ -3738,6 +3828,7 @@ class JaxChecker:
             if max_depth is not None and depth >= max_depth:
                 break
             graft_obs.level_begin(depth + 1, n_f)
+            self._hbm_note(frontier, level_sizes, max_depth, depth)
             if self.watchdog is not None:
                 # armed BEFORE the device fault sites: an injected hang
                 # at the dispatch site is exactly what it must convert

@@ -118,6 +118,12 @@ def fetch(tree):
         _obs.fetch_done(
             _time.monotonic() - t0, graft_sanitize._nbytes(out)
         )
+    # --profile N capture: one completed ledgered fetch IS one dispatch
+    # window (a superstep on the fused path, a level elsewhere) — tick
+    # the jax-profiler session so it stops after its budgeted windows
+    from ..analysis import devprof as _devprof
+
+    _devprof.profile_tick()
     return out
 
 

@@ -3,14 +3,23 @@
     python -m tla_raft_tpu.obs report RUN_DIR [BASELINE_RUN_DIR] [--json]
     python -m tla_raft_tpu.obs trace  RUN_DIR [-o OUT.json]
     python -m tla_raft_tpu.obs metrics ROOT
+    python -m tla_raft_tpu.obs trend  [BENCH_DIR] [--check] [--json]
 
 ``report`` renders a per-level table (wall, new states, dispatches,
 fetch wait, grows) from a run directory's ``events.jsonl``; with a
 second run dir it prints the two runs side by side with per-level and
 total deltas (the overhead/regression A/B view).  ``trace`` exports
 the Chrome trace-event JSON timeline (load it in
-https://ui.perfetto.dev).  ``metrics`` pretty-prints a service root's
-``metrics.json``.
+https://ui.perfetto.dev), merging any ``--profile`` device capture
+beside the host lanes.  ``metrics`` pretty-prints a service root's
+``metrics.json``.  ``trend`` renders the normalized ``docs/bench/``
+perf series (obs/trend.py) and, with ``--check``, exits non-zero on a
+hard regression (count drift, dispatch-budget drift).
+
+Runs missing optional event kinds (no superstep windows at
+``--superstep 1``, no tier events on untiered runs, no device capture
+without ``--profile``) degrade to blank columns/absent tracks — never
+an error.
 """
 
 from __future__ import annotations
@@ -22,6 +31,7 @@ import sys
 
 from . import metrics as obs_metrics
 from . import tracefile
+from . import trend as obs_trend
 from .telemetry import EVENTS_NAME, read_events
 
 
@@ -37,7 +47,7 @@ def summarize_events(events: list[dict]) -> dict:
     post-hoc twin of TelemetryHub.snapshot, for ``report``)."""
     levels: list[dict] = []
     cur = dict(dispatches=0, fetches=0, fetch_wait_s=0.0, grows=0,
-               redos=0, checkpoint_s=0.0)
+               redos=0, checkpoint_s=0.0, tier_wait_s=0.0)
     boundary = 0.0
     totals = dict(
         events=len(events), levels=0, dispatches=0, fetches=0,
@@ -45,9 +55,17 @@ def summarize_events(events: list[dict]) -> dict:
         checkpoint_s=0.0, grows=0, redos=0, supersteps=0,
         superstep_levels=0, watchdog_trips=0, wall_s=0.0,
         distinct=0, generated=0,
+        # optional-kind columns: stay zero on runs without the
+        # subsystem (untiered, --superstep 1, no --profile) and the
+        # renderers blank them out rather than erroring
+        tier_demotions=0, tier_probes=0, tier_wait_s=0.0,
+        programs_profiled=0, pre_oom_forecasts=0,
     )
     for doc in events:
-        t = float(doc.get("t", 0.0))
+        try:
+            t = float(doc.get("t", 0.0))
+        except (TypeError, ValueError):
+            t = 0.0
         k = doc.get("ev")
         totals["wall_s"] = max(totals["wall_s"], t)
         if k == "run_begin":
@@ -78,6 +96,18 @@ def summarize_events(events: list[dict]) -> dict:
             totals["superstep_levels"] += int(doc.get("levels") or 0)
         elif k == "watchdog_trip":
             totals["watchdog_trips"] += 1
+        elif k == "tier_demote":
+            totals["tier_demotions"] += 1
+            cur["tier_wait_s"] += float(doc.get("s") or 0.0)
+            totals["tier_wait_s"] += float(doc.get("s") or 0.0)
+        elif k == "tier_probe":
+            totals["tier_probes"] += 1
+            cur["tier_wait_s"] += float(doc.get("s") or 0.0)
+            totals["tier_wait_s"] += float(doc.get("s") or 0.0)
+        elif k == "program_profile":
+            totals["programs_profiled"] += 1
+        elif k == "pre_oom_forecast":
+            totals["pre_oom_forecasts"] += 1
         elif k == "level_commit":
             levels.append(dict(
                 level=int(doc.get("level") or 0),
@@ -91,25 +121,34 @@ def summarize_events(events: list[dict]) -> dict:
             totals["generated"] = int(doc.get("generated") or 0)
             boundary = t
             cur = dict(dispatches=0, fetches=0, fetch_wait_s=0.0,
-                       grows=0, redos=0, checkpoint_s=0.0)
-    for k in ("fetch_wait_s", "compile_s", "checkpoint_s", "wall_s"):
+                       grows=0, redos=0, checkpoint_s=0.0,
+                       tier_wait_s=0.0)
+    for k in ("fetch_wait_s", "compile_s", "checkpoint_s", "wall_s",
+              "tier_wait_s"):
         totals[k] = round(totals[k], 4)
     return dict(levels=levels, totals=totals)
 
 
 def _print_table(tag: str, rep: dict, out) -> None:
     t = rep["totals"]
+    # optional-subsystem columns degrade to blank, never error: a
+    # --superstep 1 run has no windows, an untiered run no tier waits
+    tiered = bool(t.get("tier_demotions") or t.get("tier_probes"))
     print(f"== {tag}: {t['levels']} levels, {t['distinct']:,} distinct, "
           f"wall {t['wall_s']:.2f}s ==", file=out)
     print(f"{'lvl':>4} {'new':>10} {'sec':>9} {'disp':>5} "
-          f"{'fetch':>5} {'wait_s':>8} {'grow':>4} {'redo':>4}",
+          f"{'fetch':>5} {'wait_s':>8} {'grow':>4} {'redo':>4}"
+          + (f" {'tier_s':>8}" if tiered else ""),
           file=out)
     for lv in rep["levels"]:
+        tier_col = (
+            f" {lv.get('tier_wait_s', 0.0):>8.3f}" if tiered else ""
+        )
         print(
             f"{lv['level']:>4} {lv['n_new']:>10,} {lv['seconds']:>9.3f} "
             f"{lv['dispatches']:>5} {lv['fetches']:>5} "
             f"{lv['fetch_wait_s']:>8.3f} {lv['grows']:>4} "
-            f"{lv['redos']:>4}",
+            f"{lv['redos']:>4}" + tier_col,
             file=out,
         )
     print(
@@ -122,6 +161,23 @@ def _print_table(tag: str, rep: dict, out) -> None:
         f"{t['supersteps']} supersteps / {t['superstep_levels']} levels",
         file=out,
     )
+    extras = []
+    if tiered:
+        extras.append(
+            f"tiered: {t.get('tier_demotions', 0)} demotions, "
+            f"{t.get('tier_probes', 0)} probes "
+            f"({t.get('tier_wait_s', 0.0):.3f}s wait)"
+        )
+    if t.get("programs_profiled"):
+        extras.append(
+            f"device cost: {t['programs_profiled']} program profiles"
+        )
+    if t.get("pre_oom_forecasts"):
+        extras.append(
+            f"PRE-OOM forecasts: {t['pre_oom_forecasts']}"
+        )
+    if extras:
+        print("        " + "; ".join(extras), file=out)
 
 
 def _cmd_report(args) -> int:
@@ -170,12 +226,15 @@ def _cmd_report(args) -> int:
 
 def _cmd_trace(args) -> int:
     src = _events_path(args.run_dir)
-    out = args.out or os.path.join(
+    run_dir = (
         args.run_dir if os.path.isdir(args.run_dir)
-        else os.path.dirname(args.run_dir) or ".",
-        "trace.json",
+        else os.path.dirname(args.run_dir) or "."
     )
-    stats = tracefile.export(src, out)
+    out = args.out or os.path.join(run_dir, "trace.json")
+    stats = tracefile.export(
+        src, out, run_dir=run_dir,
+        max_device_events=args.max_device_events,
+    )
     if stats["events"] == 0:
         print(f"{src}: no readable events", file=sys.stderr)
         return 2
@@ -183,9 +242,41 @@ def _cmd_trace(args) -> int:
         f"wrote {stats['trace_events']} trace events "
         f"(from {stats['events']} run events"
         + (f", {stats['dropped']} torn" if stats["dropped"] else "")
+        + (f", {stats['device_events']} device-lane events merged"
+           if stats.get("device_events") else "")
         + f") to {stats['out']} — load in https://ui.perfetto.dev"
     )
+    if stats.get("device_dropped"):
+        print(
+            f"(device lanes truncated: {stats['device_dropped']} "
+            "shortest slices dropped — raise --max-device-events to "
+            "keep them)"
+        )
     return 0
+
+
+def _cmd_trend(args) -> int:
+    series = obs_trend.load_series(args.bench_dir)
+    hard, soft = obs_trend.regressions(series)
+    if args.json:
+        print(json.dumps(dict(
+            records=len(series), hard=hard, soft=soft, series=series,
+        )))
+    else:
+        obs_trend.render(series)
+        for w in soft:
+            print(f"warning: trend: {w}")
+        for f in hard:
+            print(f"FAIL: trend: {f}")
+        print(
+            f"trend: {len(series)} record(s), {len(hard)} hard "
+            f"regression(s), {len(soft)} warning(s) — "
+            + ("FAIL" if hard else "OK")
+        )
+    if not series and args.check:
+        print(f"{args.bench_dir}: no trend records", file=sys.stderr)
+        return 2
+    return 1 if hard and args.check else 0
 
 
 def _cmd_metrics(args) -> int:
@@ -214,16 +305,33 @@ def main(argv=None) -> int:
     pt = sub.add_parser("trace", help="export Chrome trace JSON")
     pt.add_argument("run_dir")
     pt.add_argument("-o", "--out", default=None)
+    pt.add_argument("--max-device-events", type=int,
+                    default=tracefile.MAX_DEVICE_EVENTS,
+                    help="device-lane merge budget (shortest slices "
+                         "drop first past it; 0 = unbounded)")
 
     pm = sub.add_parser("metrics", help="render a service metrics.json")
     pm.add_argument("root")
     pm.add_argument("--json", action="store_true")
+
+    pd = sub.add_parser(
+        "trend", help="render the docs/bench/ perf-trend series"
+    )
+    pd.add_argument("bench_dir", nargs="?",
+                    default=obs_trend.BENCH_DIRNAME,
+                    help="series directory (default: docs/bench)")
+    pd.add_argument("--check", action="store_true",
+                    help="exit non-zero on a hard regression "
+                         "(count/dispatch-budget drift) — the CI gate")
+    pd.add_argument("--json", action="store_true")
 
     args = p.parse_args(argv)
     if args.cmd == "report":
         return _cmd_report(args)
     if args.cmd == "trace":
         return _cmd_trace(args)
+    if args.cmd == "trend":
+        return _cmd_trend(args)
     return _cmd_metrics(args)
 
 

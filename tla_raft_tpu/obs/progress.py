@@ -122,6 +122,18 @@ class ProgressLine:
                 parts.append(f"slab {100 * snap['slab_load']:.0f}%")
             if snap.get("dispatches"):
                 parts.append(f"{snap['levels_per_dispatch']:.2f} lvl/disp")
+            hbm = snap.get("hbm") or {}
+            if hbm.get("budget_bytes"):
+                # live device-memory gauge vs the --dev-bytes budget;
+                # the pre-OOM forecast flags the line before the
+                # reactive overflow machinery would trip
+                parts.append(f"hbm {100 * hbm.get('used_frac', 0):.0f}%")
+                if hbm.get("pre_oom_forecasts"):
+                    parts.append("PRE-OOM")
+            elif hbm.get("working_set_bytes"):
+                parts.append(
+                    f"hbm {hbm['working_set_bytes'] / 1e6:.0f}MB"
+                )
         if "configs_alive" in stats:  # service bucket progress
             parts.append(f"{stats['configs_alive']} cfg alive")
         parts.append(

@@ -50,7 +50,24 @@ Event taxonomy (docs/OBSERVABILITY.md):
                   ``level``, ``n`` fps, ``gen`` id, ``s``, ``cold``
 ``tier_probe``    one warm/cold generation probe: ``level``, ``lanes``,
                   ``hits``, ``s`` wait (the spill-overlap metric)
+``program_profile``  one compiled device program's XLA cost/memory
+                  ledger (analysis/devprof.py): ``tag``, ``flops``,
+                  ``bytes`` accessed, ``arg_b``/``out_b``/``tmp_b``/
+                  ``code_b`` memory-analysis bytes
+``buffer``        one registered long-lived device buffer (slab, ring,
+                  frontier): ``name``, ``b`` bytes — the live-HBM gauge
+``hbm_budget``    the run's device-memory budget (``--dev-bytes``)
+``pre_oom_forecast``  the forecast NEXT level's working set would bust
+                  the device budget: ``level``, ``need``, ``budget``
+                  (predictive, vs. the reactive overflow-redo)
+``profile_begin``/``profile_end``  one ``--profile N`` jax-profiler
+                  capture window (``dir`` holds the device trace)
 ================  ======================================================
+
+Rotation: the stream is capped at ``TLA_RAFT_TELEMETRY_BYTES``
+(default 64 MiB, 0 = unbounded); past the cap the file rotates to
+``events.1.jsonl`` (older generations shift up) at level/superstep
+boundaries, and :func:`read_events` follows the chain oldest-first.
 """
 
 from __future__ import annotations
@@ -67,12 +84,37 @@ FLUSH_EVERY = 64
 
 EVENTS_NAME = "events.jsonl"
 
+# rotation cap: a long tiered run would otherwise append unbounded
+DEFAULT_MAX_BYTES = 64 << 20
+
 CURRENT: "TelemetryHub | None" = None
 
 
 def enabled_by_env() -> bool:
     """Telemetry default: ON; ``TLA_RAFT_TELEMETRY=0`` disables."""
     return os.environ.get("TLA_RAFT_TELEMETRY", "1") != "0"
+
+
+def max_bytes_from_env() -> int:
+    """Rotation byte budget (``TLA_RAFT_TELEMETRY_BYTES``; 0 = never
+    rotate)."""
+    v = os.environ.get("TLA_RAFT_TELEMETRY_BYTES")
+    if v is None or v == "":
+        return DEFAULT_MAX_BYTES
+    return max(0, int(float(v)))
+
+
+def rotated_paths(path: str) -> list[str]:
+    """The sealed rotation chain of ``path``, OLDEST first
+    (``events.N.jsonl`` ... ``events.1.jsonl``); empty when the stream
+    never rotated."""
+    base, ext = os.path.splitext(path)
+    out: list[str] = []
+    n = 1
+    while os.path.exists(f"{base}.{n}{ext}"):
+        out.append(f"{base}.{n}{ext}")
+        n += 1
+    return list(reversed(out))
 
 
 def install(hub: "TelemetryHub | None") -> None:
@@ -100,6 +142,36 @@ def _clean(v):
     if isinstance(v, dict):
         return {str(k): _clean(x) for k, x in v.items()}
     return str(v)
+
+
+def hbm_gauge(buffers: dict, program_temp: dict,
+              budget: int = 0) -> dict:
+    """The live device-memory gauge: registered long-lived buffers
+    (slab, ring, frontier caps) + the worst profiled program's temp
+    bytes.  Pure arithmetic — the one place the ``--json`` ``hbm``
+    block and ``--progress`` compute occupancy, so the two can never
+    disagree.  ``headroom_bytes`` is present only under a budget and
+    may be negative (a transiently over-budget working set — the
+    pre-OOM forecast's trigger condition)."""
+    resident = int(sum(buffers.values()))
+    temp_tag, temp_peak = None, 0
+    for tag, b in program_temp.items():
+        if b > temp_peak:
+            temp_tag, temp_peak = tag, int(b)
+    out = dict(
+        buffers={k: int(v) for k, v in sorted(buffers.items())},
+        resident_bytes=resident,
+        temp_peak_bytes=temp_peak,
+        temp_peak_program=temp_tag,
+        working_set_bytes=resident + temp_peak,
+    )
+    if budget:
+        out["budget_bytes"] = int(budget)
+        out["headroom_bytes"] = int(budget) - resident - temp_peak
+        out["used_frac"] = round(
+            (resident + temp_peak) / budget, 4
+        )
+    return out
 
 
 def _line_digest(core: str) -> str:
@@ -130,20 +202,13 @@ def decode_line(line: str) -> dict | None:
     return doc
 
 
-def read_events(path: str) -> tuple[list[dict], int]:
-    """Read an event stream, tolerating a torn tail.
-
-    Returns ``(events, dropped)``: every digest-verified event up to
-    the first bad line, and the count of lines dropped from there on
-    (0 on a clean file).  Never raises on torn/corrupt content — a
-    crashed writer's half-line is the EXPECTED failure mode.
-    """
+def _read_one(path: str) -> tuple[list[dict], int]:
     events: list[dict] = []
     dropped = 0
     try:
         with open(path, encoding="utf-8", errors="replace") as fh:
             lines = fh.read().splitlines()
-    except FileNotFoundError:
+    except (FileNotFoundError, OSError):
         return [], 0
     for i, line in enumerate(lines):
         if not line.strip():
@@ -153,6 +218,27 @@ def read_events(path: str) -> tuple[list[dict], int]:
             dropped = sum(1 for x in lines[i:] if x.strip())
             break
         events.append(doc)
+    return events, dropped
+
+
+def read_events(path: str, follow_rotation: bool = True
+                ) -> tuple[list[dict], int]:
+    """Read an event stream, tolerating a torn tail.
+
+    Returns ``(events, dropped)``: every digest-verified event up to
+    the first bad line per file, and the count of lines dropped (0 on
+    a clean stream).  Never raises on torn/corrupt content — a crashed
+    writer's half-line is the EXPECTED failure mode.  A rotated stream
+    (``events.N.jsonl`` siblings) is spliced back together oldest-
+    first, so ``report``/``trace`` see the whole run.
+    """
+    events: list[dict] = []
+    dropped = 0
+    chain = rotated_paths(path) if follow_rotation else []
+    for p in chain + [path]:
+        ev, dr = _read_one(p)
+        events.extend(ev)
+        dropped += dr
     return events, dropped
 
 
@@ -224,10 +310,16 @@ class TelemetryHub:
     """
 
     def __init__(self, run_dir: str | None = None,
-                 path: str | None = None):
+                 path: str | None = None,
+                 max_bytes: int | None = None):
         if path is None and run_dir is not None:
             path = os.path.join(run_dir, EVENTS_NAME)
         self.path = path
+        self.max_bytes = (
+            max_bytes_from_env() if max_bytes is None else max(0, max_bytes)
+        )
+        self.rotations = 0
+        self._size = 0  # active file's byte size (approx, append-only)
         self.healed_lines = 0
         self._fh = None
         self._buf: list[str] = []
@@ -245,9 +337,26 @@ class TelemetryHub:
         self._t_off = 0.0
         if path is not None and os.path.exists(path):
             self.healed_lines = _heal_tail(path)
+            self._size = os.path.getsize(path)
             last = _last_event_t(path)
+            if last is None:
+                # active file healed to empty (crash right after a
+                # rotation): the clock rebase reads the newest SEALED
+                # generation so the spliced chain stays monotonic
+                for p in reversed(rotated_paths(path)):
+                    last = _last_event_t(p)
+                    if last is not None:
+                        break
             if last is not None:
                 self._t_off = last + 1e-6
+        elif path is not None:
+            # fresh active file, but a rotated chain may survive from
+            # a crashed predecessor — rebase past it
+            for p in reversed(rotated_paths(path)):
+                last = _last_event_t(p)
+                if last is not None:
+                    self._t_off = last + 1e-6
+                    break
         self.n_events = 0
         # -- aggregates (the --json telemetry block) ----------------------
         self.levels = 0
@@ -287,6 +396,17 @@ class TelemetryHub:
         self.tier_probe_lanes = 0
         self.tier_probe_hits = 0
         self.tier_probe_wait_s = 0.0
+        # device-cost observatory (analysis/devprof.py): per-program
+        # XLA cost/memory ledgers + the live-HBM gauge assembled from
+        # the registered long-lived buffers and the worst program temp
+        self.programs_profiled = 0
+        self.program_temp: dict[str, int] = {}  # tag -> max temp bytes
+        self.program_flops: dict[str, float] = {}  # tag -> max flops
+        self.hbm_buffers: dict[str, int] = {}  # name -> live bytes
+        self.hbm_budget = 0
+        self.pre_oom_forecasts = 0
+        self.last_pre_oom: dict | None = None
+        self.profile_windows = 0
         self.slab_cap = 0
         self.distinct = 0
         self._last_boundary = self._t_off
@@ -321,10 +441,12 @@ class TelemetryHub:
             buf, self._buf = self._buf, []
         if not buf:
             return
+        data = "".join(buf)
         with self._io_lock:
             fh = self._open()
-            fh.write("".join(buf))
+            fh.write(data)
             fh.flush()
+            self._size += len(data)
 
     def flush_best_effort(self, timeout: float = 2.0) -> None:
         """Bounded-time flush for paths that must never block (the
@@ -360,9 +482,40 @@ class TelemetryHub:
         # set — the watchdog thread must never block on a hung
         # filesystem (it uses flush_best_effort instead)
         if do_flush or ev in (
-            "level_commit", "run_end", "checkpoint", "integrity",
+            "level_commit", "superstep_commit", "run_end",
+            "checkpoint", "integrity",
         ):
             self.flush()
+            # rotation happens only at these committed boundaries, so
+            # a generation never splits a level's events mid-window
+            if ev in ("level_commit", "superstep_commit"):
+                self._maybe_rotate()
+
+    def _maybe_rotate(self) -> None:
+        """Rotate ``events.jsonl`` -> ``events.1.jsonl`` (older
+        generations shift up) once the active file exceeds the byte
+        budget.  Called at level/superstep boundaries only."""
+        if (self.path is None or not self.max_bytes
+                or self._size < self.max_bytes):
+            return
+        with self._io_lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+            base, ext = os.path.splitext(self.path)
+            n = 1
+            while os.path.exists(f"{base}.{n}{ext}"):
+                n += 1
+            for i in range(n, 1, -1):
+                # the stream is already self-checking per line, so a
+                # rotation rename is not a checkpoint commit
+                # graftlint: waive[GL009] — log-rotation rename
+                os.replace(f"{base}.{i - 1}{ext}", f"{base}.{i}{ext}")
+            if os.path.exists(self.path):
+                # graftlint: waive[GL009] — log-rotation rename (above)
+                os.replace(self.path, f"{base}.1{ext}")
+            self._size = 0
+            self.rotations += 1
 
     def _aggregate(self, ev: str, t: float, doc: dict) -> None:
         if ev == "dispatch":
@@ -425,6 +578,29 @@ class TelemetryHub:
             self.tier_probe_lanes += int(doc.get("lanes") or 0)
             self.tier_probe_hits += int(doc.get("hits") or 0)
             self.tier_probe_wait_s += float(doc.get("s") or 0.0)
+        elif ev == "program_profile":
+            self.programs_profiled += 1
+            tag = str(doc.get("tag"))
+            tmp = int(doc.get("tmp_b") or 0)
+            if tmp > self.program_temp.get(tag, -1):
+                self.program_temp[tag] = tmp
+            fl = float(doc.get("flops") or 0.0)
+            if fl > self.program_flops.get(tag, -1.0):
+                self.program_flops[tag] = fl
+        elif ev == "buffer":
+            self.hbm_buffers[str(doc.get("name"))] = int(
+                doc.get("b") or 0
+            )
+        elif ev == "hbm_budget":
+            self.hbm_budget = int(doc.get("b") or 0)
+        elif ev == "pre_oom_forecast":
+            self.pre_oom_forecasts += 1
+            self.last_pre_oom = dict(
+                level=doc.get("level"), need=doc.get("need"),
+                budget=doc.get("budget"),
+            )
+        elif ev == "profile_end":
+            self.profile_windows += int(doc.get("windows") or 0)
         elif ev == "run_begin":
             self._last_boundary = t
 
@@ -473,6 +649,22 @@ class TelemetryHub:
             if self.exchange_bytes or self.exchange_raw_bytes:
                 out["exchange_bytes"] = self.exchange_bytes
                 out["exchange_raw_bytes"] = self.exchange_raw_bytes
+            if self.programs_profiled:
+                out["programs_profiled"] = self.programs_profiled
+                out["program_temp_bytes"] = dict(self.program_temp)
+            if self.rotations:
+                out["rotations"] = self.rotations
+            if self.profile_windows:
+                out["profile_windows"] = self.profile_windows
+            if self.hbm_buffers or self.hbm_budget:
+                hbm = hbm_gauge(
+                    self.hbm_buffers, self.program_temp,
+                    self.hbm_budget,
+                )
+                if self.pre_oom_forecasts:
+                    hbm["pre_oom_forecasts"] = self.pre_oom_forecasts
+                    hbm["last_pre_oom"] = dict(self.last_pre_oom or {})
+                out["hbm"] = hbm
             if self.tier_demotions or self.tier_probes:
                 out["tiered"] = dict(
                     demotions=self.tier_demotions,
@@ -640,3 +832,48 @@ def tier_probe(level, lanes, hits, sieve: int = 0,
     if hub is not None:
         hub.emit("tier_probe", level=level, lanes=lanes, hits=hits,
                  sieve=sieve, s=round(wait_s, 6))
+
+
+def program_profile(tag: str, **metrics) -> None:
+    """One compiled program's XLA cost/memory ledger (flops, bytes
+    accessed, argument/output/temp/code bytes) — published from the
+    compile choke points by analysis/devprof.py, once per program
+    shape."""
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("program_profile", tag=tag, **metrics)
+
+
+def buffer(name: str, nbytes) -> None:
+    """Register/resize one long-lived device buffer (the HBM gauge):
+    the newest ``b`` per name wins — emit 0 to retire a buffer."""
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("buffer", name=name, b=int(nbytes))
+
+
+def hbm_budget(nbytes) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("hbm_budget", b=int(nbytes))
+
+
+def pre_oom(level, need_bytes, budget_bytes, **fields) -> None:
+    """The forecast next level's working set would bust the device
+    budget — the predictive twin of the reactive overflow-redo."""
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("pre_oom_forecast", level=level, need=int(need_bytes),
+                 budget=int(budget_bytes), **fields)
+
+
+def profile_begin(trace_dir: str, windows: int) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("profile_begin", dir=trace_dir, windows=windows)
+
+
+def profile_end(trace_dir: str, windows: int) -> None:
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("profile_end", dir=trace_dir, windows=windows)

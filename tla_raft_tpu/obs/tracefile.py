@@ -34,7 +34,10 @@ stream's last timestamp).  Host-pure (graftlint GL012): stdlib only.
 
 from __future__ import annotations
 
+import glob
+import gzip
 import json
+import os
 
 from .telemetry import read_events
 
@@ -49,6 +52,7 @@ TRACKS = {
     7: "grow/redo",
     8: "watchdog/audit",
     9: "tiered store",
+    10: "device cost",
 }
 
 
@@ -144,6 +148,22 @@ def to_chrome_trace(events: list[dict]) -> dict:
                args=dict(level=doc.get("level"),
                          lanes=doc.get("lanes"),
                          hits=doc.get("hits")))
+        elif kind in ("program_profile", "buffer", "hbm_budget",
+                      "pre_oom_forecast", "profile_begin",
+                      "profile_end"):
+            name = {
+                "program_profile":
+                    f"profile {doc.get('tag')}",
+                "buffer": f"buffer {doc.get('name')}",
+                "hbm_budget": "hbm budget",
+                "pre_oom_forecast":
+                    f"PRE-OOM FORECAST (level {doc.get('level')})",
+                "profile_begin": "profiler start",
+                "profile_end": "profiler stop",
+            }[kind]
+            ev("i", 10, name, t, args={
+                k: v for k, v in doc.items() if k not in ("t", "ev")
+            })
         elif kind in ("audit", "retire", "integrity", "shape",
                       "exchange", "skew"):
             ev("i", 8, kind, t, args={
@@ -158,13 +178,140 @@ def to_chrome_trace(events: list[dict]) -> dict:
     )
 
 
-def export(events_path: str, out_path: str) -> dict:
-    """events.jsonl -> Chrome trace JSON file; returns small stats."""
+# device lanes from --profile captures merge in as separate processes
+# starting at this pid (host lanes stay at PID=1)
+DEVICE_PID_BASE = 100
+
+_MERGE_PHASES = {"X", "M", "i", "I", "B", "E", "C"}
+
+
+def _profile_dirs(events: list[dict], run_dir: str | None) -> list[str]:
+    """Capture dirs named by profile_begin events, plus the run dir's
+    conventional ``profile/`` (covers a relocated run dir whose events
+    recorded the original absolute path)."""
+    dirs: list[str] = []
+    for ev in events:
+        if ev.get("ev") == "profile_begin" and ev.get("dir"):
+            dirs.append(str(ev["dir"]))
+    if run_dir:
+        dirs.append(os.path.join(run_dir, "profile"))
+    out, seen = [], set()
+    for d in dirs:
+        if not os.path.isdir(d):
+            continue
+        # dedup by resolved path: the profile_begin event records the
+        # original (possibly relative) dir and the run-dir convention
+        # adds another spelling of the same directory
+        real = os.path.realpath(d)
+        if real not in seen:
+            seen.add(real)
+            out.append(d)
+    return out
+
+
+# device-lane merge budget: the profiler's host lanes on CPU emit
+# ~10^6 sub-microsecond slices per window (codegen internals); past the
+# budget the SHORTEST slices are dropped first — the timeline keeps the
+# compute that matters and the drop is reported, never silent
+MAX_DEVICE_EVENTS = 250_000
+
+
+def merge_device_lanes(doc: dict, events: list[dict],
+                       run_dir: str | None = None,
+                       max_events: int = MAX_DEVICE_EVENTS
+                       ) -> tuple[int, int]:
+    """Merge ``--profile`` device traces into the host timeline.
+
+    A capture's ``profile_begin`` event carries BOTH the trace dir and
+    the hub timestamp of ``start_trace`` — and the jax Perfetto trace's
+    timestamps are microseconds from that same instant, so shifting
+    every device event by the begin event's ``t`` lands the device
+    lanes on the host clock: dispatch instant -> device compute ->
+    fetch-wait read off one timeline.  Device processes are re-pinned
+    to pids >= ``DEVICE_PID_BASE`` (the host tracks keep PID 1) and
+    their process names prefixed ``device:``.  Missing/torn captures
+    merge nothing — the host trace stays valid.  Returns
+    ``(merged, dropped)`` device-event counts.
+    """
+    out = doc["traceEvents"]
+    pid_map: dict = {}
+    meta: list[dict] = []
+    slices: list[dict] = []
+    offsets = {
+        str(ev.get("dir")): float(ev.get("t") or 0.0)
+        for ev in events if ev.get("ev") == "profile_begin"
+    }
+    default_off = min(offsets.values()) if offsets else 0.0
+    for d in _profile_dirs(events, run_dir):
+        off_us = offsets.get(d, default_off) * 1e6
+        for path in sorted(glob.glob(os.path.join(
+            d, "plugins", "profile", "*", "perfetto_trace.json.gz"
+        ))):
+            try:
+                with gzip.open(path, "rt", encoding="utf-8",
+                               errors="replace") as fh:
+                    dev = json.load(fh)
+            except (OSError, ValueError, EOFError):
+                continue  # torn capture: keep the host trace valid
+            evs = (
+                dev.get("traceEvents", [])
+                if isinstance(dev, dict) else dev
+            )
+            for e in evs:
+                if not isinstance(e, dict):
+                    continue
+                ph, pid = e.get("ph"), e.get("pid")
+                if ph not in _MERGE_PHASES or pid is None:
+                    continue
+                if pid not in pid_map:
+                    pid_map[pid] = DEVICE_PID_BASE + len(pid_map)
+                e2 = dict(e, pid=pid_map[pid], cat="device")
+                if ph == "M":
+                    if (e.get("name") == "process_name"
+                            and isinstance(e.get("args"), dict)):
+                        e2["args"] = dict(
+                            e["args"],
+                            name=f"device: {e['args'].get('name')}",
+                        )
+                    meta.append(e2)
+                    continue
+                e2["ts"] = float(e.get("ts") or 0.0) + off_us
+                if ph in ("B", "E"):
+                    # B/E pairs are never droppable: losing one side
+                    # of a pair breaks the nesting the merged trace
+                    # guarantees (they ride with the metadata)
+                    meta.append(e2)
+                else:
+                    slices.append(e2)
+    dropped = 0
+    if max_events and len(slices) > max_events:
+        # keep the longest slices (instants/counters sort as dur 0 but
+        # are few); the drop is REPORTED by the caller, never silent
+        slices.sort(key=lambda e: -float(e.get("dur") or 0.0))
+        dropped = len(slices) - max_events
+        slices = slices[:max_events]
+    out.extend(meta)
+    out.extend(slices)
+    return len(meta) + len(slices), dropped
+
+
+def export(events_path: str, out_path: str,
+           run_dir: str | None = None,
+           max_device_events: int = MAX_DEVICE_EVENTS) -> dict:
+    """events.jsonl -> Chrome trace JSON file; returns small stats.
+
+    ``run_dir`` (when given) also merges any ``--profile`` device
+    capture found beside the stream into the same timeline."""
     events, dropped = read_events(events_path)
     doc = to_chrome_trace(events)
+    device_events, device_dropped = merge_device_lanes(
+        doc, events, run_dir, max_events=max_device_events
+    )
     with open(out_path, "w", encoding="utf-8") as fh:
         json.dump(doc, fh)
     return dict(
         events=len(events), dropped=dropped,
-        trace_events=len(doc["traceEvents"]), out=out_path,
+        trace_events=len(doc["traceEvents"]),
+        device_events=device_events,
+        device_dropped=device_dropped, out=out_path,
     )

@@ -1,0 +1,404 @@
+"""Perf-trend series: normalized bench records + regression flags.
+
+The repo accumulated one bench artifact per round in three dialects —
+legacy harness wrappers (``{"n", "cmd", "rc", "tail", "parsed"}``),
+canonical ``tla-raft-bench/1`` round records, and ``tla-raft-bench-ab/1``
+A/B records with per-arm walls — scattered between the repo root and
+``docs/``.  This module folds them all into ONE ``docs/bench/`` series
+with a single schema (``tla-raft-trend/1``), renders the trajectory
+(tables + sparklines), and flags regressions:
+
+* **hard** (exit non-zero from ``obs trend --check``): a later round of
+  the SAME metric+config reports different model counts
+  (distinct/generated/depth — the checker's correctness surface; wall
+  clocks wobble, counts never may), or its dispatch amortization
+  regresses (``levels_per_dispatch`` drops / worst
+  dispatches-per-level grows — the GL011 budget surface, re-checked on
+  the committed history).
+* **soft** (warn only): the latest wall/rate is worse than the
+  windowed median of its predecessors beyond a tolerance band — CPU
+  walls on shared boxes are noisy, so walls warn, never fail.
+
+``bench.py`` appends each round's record through
+:func:`append_record`, so the series grows as a side effect of running
+the bench — no separate bookkeeping step.  Host-pure (graftlint
+GL012): stdlib only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+SCHEMA = "tla-raft-trend/1"
+BENCH_DIRNAME = os.path.join("docs", "bench")
+
+# soft-warn band: latest wall > median-of-window * (1 + this)
+WALL_TOLERANCE = 0.35
+# rate uses the inverse band (latest rate < median / (1 + this))
+RATE_TOLERANCE = 0.35
+MEDIAN_WINDOW = 5
+
+_ROUND_RE = re.compile(r"r(\d+)")
+
+SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values) -> str:
+    """Unicode sparkline of a numeric series ('' when empty; gaps
+    render as spaces)."""
+    vals = [v for v in values if isinstance(v, (int, float))]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    out = []
+    for v in values:
+        if not isinstance(v, (int, float)):
+            out.append(" ")
+            continue
+        out.append(SPARK[int((v - lo) / span * (len(SPARK) - 1))])
+    return "".join(out)
+
+
+def round_from_name(name: str) -> int | None:
+    """``BENCH_r06.json`` / ``r17_tiered_ab.json`` -> the round."""
+    m = _ROUND_RE.search(os.path.basename(name))
+    return int(m.group(1)) if m else None
+
+
+def normalize(doc: dict, *, round_no: int | None = None,
+              source: str | None = None) -> dict | None:
+    """One bench artifact (any historical dialect) -> one trend record.
+
+    Returns None for artifacts with nothing comparable (e.g. a legacy
+    wrapper whose ``parsed`` is null — the run crashed before the
+    summary line).  The normalized record:
+
+    ====================  ===============================================
+    ``schema``            ``tla-raft-trend/1``
+    ``round``             campaign round (int) — the series' x axis
+    ``metric``            bench family (``raft_cfg_check_depth11`` ...)
+    ``config``            config describe string (count-identity key)
+    ``wall_s``            wall seconds (primary arm)
+    ``rate``              steady states/s or jobs/h (primary arm)
+    ``unit``              rate unit
+    ``distinct``/``generated``/``depth``  model counts (count gate)
+    ``parity``/``ok``     the round's own verdicts (tri-state)
+    ``levels_per_dispatch``/``max_dispatches_per_level``  GL011 surface
+    ``arms``              per-arm wall/rate for A/B records
+    ``device``/``source``  provenance
+    ====================  ===============================================
+    """
+    if not isinstance(doc, dict):
+        return None
+    # legacy harness wrapper: the payload is in "parsed"
+    if "parsed" in doc and "schema" not in doc:
+        inner = doc.get("parsed")
+        if not isinstance(inner, dict):
+            return None
+        return normalize(inner, round_no=round_no, source=source)
+    if doc.get("schema") == SCHEMA:
+        out = dict(doc)
+        if round_no is not None and out.get("round") is None:
+            out["round"] = round_no
+        return out
+
+    out: dict = {"schema": SCHEMA, "round": round_no, "source": source}
+    if doc.get("schema") == "tla-raft-bench-ab/1":
+        # A/B record: keep both arms, promote the shipped/default arm
+        # (the first) as the primary wall/rate
+        out["metric"] = doc.get("metric") or _ab_metric(doc, source)
+        arms = _ab_arms(doc)
+        out["arms"] = arms
+        if arms:
+            first = next(iter(arms.values()))
+            out["wall_s"] = first.get("wall_s")
+            out["rate"] = first.get("rate")
+        out["unit"] = doc.get("unit") or "distinct_states_per_sec"
+        for k in ("config", "distinct", "generated", "depth",
+                  "device"):
+            if k in doc:
+                out[k] = doc[k]
+        out["parity"] = doc.get("parity",
+                                doc.get("counts_bit_identical"))
+        out["ok"] = doc.get("ok", out["parity"])
+        return out
+
+    # canonical bench/1 records and bare summary dicts share keys
+    metric = doc.get("metric")
+    if metric is None:
+        return None
+    out["metric"] = metric
+    out["config"] = doc.get("config")
+    out["wall_s"] = doc.get("wall_s")
+    out["rate"] = (
+        doc.get("steady_rate", doc.get("jobs_per_hour",
+                                       doc.get("value")))
+    )
+    out["unit"] = doc.get("unit")
+    for k in ("distinct", "generated", "depth", "parity", "ok",
+              "device", "vs_baseline", "levels_per_dispatch",
+              "steady_max_dispatches_per_level", "mesh", "mesh_deep",
+              "tiered_bytes"):
+        if k in doc and doc[k] is not None:
+            out[k] = doc[k]
+    if "steady_max_dispatches_per_level" in out:
+        out["max_dispatches_per_level"] = out.pop(
+            "steady_max_dispatches_per_level"
+        )
+    return out
+
+
+def _ab_metric(doc: dict, source: str | None) -> str:
+    """A/B records carry no metric field; derive one from the source
+    file name (``BENCH_TIERED_AB_r17.json`` -> ``ab_tiered``)."""
+    name = os.path.basename(source or "ab").lower()
+    name = re.sub(r"^bench_", "", name)
+    name = re.sub(r"_?ab_?r?\d*\.json$", "", name)
+    return f"ab_{name or 'unknown'}"
+
+
+def _ab_arms(doc: dict) -> dict:
+    arms: dict = {}
+    if isinstance(doc.get("arms"), dict):
+        for name, arm in doc["arms"].items():
+            if isinstance(arm, dict):
+                arms[name] = dict(
+                    wall_s=arm.get("wall_s"),
+                    rate=arm.get("steady_rate", arm.get("rate",
+                                 arm.get("jobs_per_hour"))),
+                    **{k: arm[k] for k in (
+                        "levels_per_dispatch",
+                        "steady_max_dispatches_per_level",
+                    ) if k in arm},
+                )
+    elif isinstance(doc.get("wall_s"), dict):
+        rates = doc.get("steady_rate")
+        rates = rates if isinstance(rates, dict) else {}
+        for name, wall in doc["wall_s"].items():
+            arms[name] = dict(wall_s=wall, rate=rates.get(name))
+    return arms
+
+
+def record_name(rec: dict) -> str:
+    """Series file name: ``r<NN>_<metric>[_<variant>].json``."""
+    rnd = rec.get("round")
+    rnd = f"r{int(rnd):02d}" if rnd is not None else "rxx"
+    metric = re.sub(r"[^A-Za-z0-9_.-]+", "_",
+                    str(rec.get("metric", "unknown")))
+    variant = rec.get("variant")
+    suffix = (
+        "_" + re.sub(r"[^A-Za-z0-9_.-]+", "_", str(variant))
+        if variant else ""
+    )
+    return f"{rnd}_{metric}{suffix}.json"
+
+
+def append_record(doc: dict, bench_dir: str,
+                  round_no: int | None = None,
+                  source: str | None = None,
+                  variant: str | None = None) -> str | None:
+    """Normalize one bench artifact into the series directory.
+
+    Returns the written path (None when the artifact normalizes to
+    nothing).  Same round + metric (+ variant) overwrites — re-running
+    a round's bench updates its point instead of forking the series.
+    ``variant`` disambiguates multiple same-metric runs of one round
+    (cold/warm, different scale dials) — variants form their OWN trend
+    key, so a cold-start wall never reads as a warm regression."""
+    rec = normalize(doc, round_no=round_no, source=source)
+    if rec is None:
+        return None
+    if variant:
+        rec["variant"] = str(variant)
+    os.makedirs(bench_dir, exist_ok=True)
+    path = os.path.join(bench_dir, record_name(rec))
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(rec, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    # the trend gate re-reads and re-validates the whole series, so
+    # this is a bench record, not a checkpoint artifact
+    # graftlint: waive[GL009] — bench-series record, not a checkpoint
+    os.replace(tmp, path)
+    return path
+
+
+def load_series(bench_dir: str) -> list[dict]:
+    """Every readable record in the series, sorted by (round, metric).
+    Unreadable/alien files are skipped — the gate reports on what IS
+    comparable."""
+    out: list[dict] = []
+    try:
+        names = sorted(os.listdir(bench_dir))
+    except OSError:
+        return []
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(bench_dir, name)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        rec = normalize(doc, round_no=round_from_name(name),
+                        source=name)
+        if rec is not None and rec.get("round") is not None:
+            out.append(rec)
+    out.sort(key=lambda r: (int(r["round"]), str(r.get("metric"))))
+    return out
+
+
+def _by_key(series: list[dict]) -> dict:
+    groups: dict = {}
+    for rec in series:
+        key = (
+            str(rec.get("metric")), str(rec.get("config")),
+            str(rec.get("variant") or ""),
+        )
+        groups.setdefault(key, []).append(rec)
+    return groups
+
+
+def _median(vals):
+    vals = sorted(vals)
+    return vals[len(vals) // 2] if vals else None
+
+
+def regressions(series: list[dict]) -> tuple[list[str], list[str]]:
+    """(hard failures, soft warnings) over the normalized series.
+
+    Hard: count drift (distinct/generated/depth changed between rounds
+    of the same metric+config — a silently wrong checker), parity/ok
+    flipping to False, and dispatch-budget drift (levels_per_dispatch
+    shrinking / max dispatches growing).  Soft: latest wall/rate worse
+    than the windowed median beyond the tolerance band.
+    """
+    hard: list[str] = []
+    soft: list[str] = []
+    for (metric, _cfg, variant), recs in _by_key(series).items():
+        if len(recs) < 2:
+            continue
+        latest, prior = recs[-1], recs[:-1]
+        tag = f"{metric}{f'/{variant}' if variant else ''} " \
+              f"r{latest.get('round')}"
+        # -- count identity (the correctness surface) -----------------
+        for k in ("distinct", "generated", "depth"):
+            base = next(
+                (r[k] for r in reversed(prior) if r.get(k) is not None),
+                None,
+            )
+            if base is not None and latest.get(k) is not None \
+                    and latest[k] != base:
+                hard.append(
+                    f"{tag}: {k} drifted {base} -> {latest[k]} on an "
+                    "identical config — count regression (the wall "
+                    "clock may lie; counts may not)"
+                )
+        if latest.get("parity") is False or latest.get("ok") is False:
+            hard.append(
+                f"{tag}: round recorded "
+                f"parity={latest.get('parity')} ok={latest.get('ok')}"
+            )
+        # -- dispatch-budget drift (the GL011 surface) ----------------
+        base_lpd = next(
+            (r["levels_per_dispatch"] for r in reversed(prior)
+             if r.get("levels_per_dispatch") is not None), None,
+        )
+        if base_lpd and latest.get("levels_per_dispatch") is not None \
+                and latest["levels_per_dispatch"] < base_lpd - 1e-9:
+            hard.append(
+                f"{tag}: levels/dispatch regressed {base_lpd} -> "
+                f"{latest['levels_per_dispatch']} — the dispatch "
+                "amortization shrank (GL011's surface, on the "
+                "committed history)"
+            )
+        base_mdl = next(
+            (r["max_dispatches_per_level"] for r in reversed(prior)
+             if r.get("max_dispatches_per_level") is not None), None,
+        )
+        if base_mdl is not None \
+                and latest.get("max_dispatches_per_level") is not None \
+                and latest["max_dispatches_per_level"] > base_mdl:
+            hard.append(
+                f"{tag}: worst dispatches/level grew {base_mdl} -> "
+                f"{latest['max_dispatches_per_level']}"
+            )
+        # -- wall/rate trend (soft: CPU walls are noisy) --------------
+        walls = [r["wall_s"] for r in prior[-MEDIAN_WINDOW:]
+                 if isinstance(r.get("wall_s"), (int, float))]
+        med = _median(walls)
+        if med and isinstance(latest.get("wall_s"), (int, float)) \
+                and latest["wall_s"] > med * (1 + WALL_TOLERANCE):
+            soft.append(
+                f"{tag}: wall {latest['wall_s']:.1f}s vs windowed "
+                f"median {med:.1f}s (+{WALL_TOLERANCE:.0%} band) — "
+                "soft warn (CPU walls are noisy; silicon gates are "
+                "the A/B records)"
+            )
+        rates = [r["rate"] for r in prior[-MEDIAN_WINDOW:]
+                 if isinstance(r.get("rate"), (int, float))]
+        med_r = _median(rates)
+        if med_r and isinstance(latest.get("rate"), (int, float)) \
+                and latest["rate"] < med_r / (1 + RATE_TOLERANCE):
+            soft.append(
+                f"{tag}: rate {latest['rate']:,.0f} vs windowed "
+                f"median {med_r:,.0f} — soft warn"
+            )
+    return hard, soft
+
+
+def render(series: list[dict], out=None) -> None:
+    """Trajectory tables + sparklines, one block per metric family."""
+    import sys
+
+    out = out if out is not None else sys.stdout
+    if not series:
+        print("no trend records (docs/bench/ empty?)", file=out)
+        return
+    for (metric, _cfg, variant), recs in sorted(_by_key(series).items()):
+        rates = [r.get("rate") for r in recs]
+        label = f"{metric} [{variant}]" if variant else metric
+        print(f"== {label}  {sparkline(rates)}", file=out)
+        cfg = recs[-1].get("config")
+        if cfg:
+            print(f"   config: {cfg}", file=out)
+        print(
+            f"   {'rnd':>4} {'wall_s':>9} {'rate':>12} {'distinct':>10}"
+            f" {'depth':>5} {'par':>4} {'lvl/disp':>8}", file=out,
+        )
+        for r in recs:
+            par = r.get("parity")
+            print(
+                f"   {r.get('round', '?'):>4}"
+                f" {_fmt(r.get('wall_s'), '9.1f')}"
+                f" {_fmt(r.get('rate'), '12,.0f')}"
+                f" {_fmt(r.get('distinct'), '10,d')}"
+                f" {_fmt(r.get('depth'), '5d')}"
+                f" {'  ok' if par else ('   ?' if par is None else ' BAD'):>4}"
+                f" {_fmt(r.get('levels_per_dispatch'), '8.2f')}",
+                file=out,
+            )
+        arms = recs[-1].get("arms")
+        if arms:
+            for name, arm in arms.items():
+                print(
+                    f"     arm {name}: wall "
+                    f"{_fmt(arm.get('wall_s'), '.1f')}s, rate "
+                    f"{_fmt(arm.get('rate'), ',.0f')}", file=out,
+                )
+
+
+def _fmt(v, spec: str) -> str:
+    if v is None:
+        width = re.match(r"(\d+)", spec)
+        return " " * int(width.group(1)) if width else "-"
+    try:
+        if spec.endswith("d"):
+            v = int(v)
+        return format(v, spec)
+    except (ValueError, TypeError):
+        return str(v)

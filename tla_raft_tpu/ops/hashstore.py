@@ -435,6 +435,15 @@ class DeviceHashStore:
         self.cap = cap
         self.count = count
         self.slab = make_slab(cap)
+        self._note_buffer()
+
+    def _note_buffer(self) -> None:
+        # live-HBM gauge (obs/telemetry.py): the slab is the run's
+        # dominant long-lived device buffer — every capacity change
+        # re-registers it (8 B per u64 slot)
+        from ..obs import telemetry as _obs
+
+        _obs.buffer("hslab", self.cap * 8)
 
     @classmethod
     def from_fps(cls, fps: np.ndarray, cap: int | None = None):
@@ -449,6 +458,7 @@ class DeviceHashStore:
         if n:
             insert_np(arr, fps)
         st.slab = jnp.asarray(arr)
+        st._note_buffer()
         return st
 
     def need_grow(self, extra: int = 0) -> bool:
@@ -486,6 +496,7 @@ class DeviceHashStore:
             want *= 2
         self.cap = want
         self.slab = slab2
+        self._note_buffer()
 
     def reserve(self, expected: int):
         """Forecast presize: grow (never shrink) to hold ``expected``
@@ -543,6 +554,7 @@ class DeviceHashStore:
             st.cap = cap
             st.count = cnt
             st.slab = jnp.asarray(z["slab"])
+            st._note_buffer()
             return st
         except (OSError, ValueError, KeyError, EOFError,
                 zipfile.BadZipFile):

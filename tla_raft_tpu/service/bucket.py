@@ -63,6 +63,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import resilience
+from ..analysis import devprof as graft_devprof
 from ..analysis import sanitize as graft_sanitize
 from ..obs import telemetry as graft_obs
 from ..config import RaftConfig
@@ -808,12 +809,23 @@ class BatchedChecker:
                     "sstep", B, int(slab.shape[0]), g_cap, span, ring
                 )
                 graft_sanitize.superstep_begin()
+                done_dev = jnp.asarray(done_pad)
+                depth_dev = jnp.asarray(depth_pad)
+                cap_dev = jnp.asarray(cap_pad)
+                # device-cost observatory: harvest the bucket
+                # superstep's XLA cost/memory ledger once per shape
+                # (compile-time only; see analysis/devprof.py)
+                graft_devprof.profile_program(
+                    "service.superstep", progs.sstep,
+                    st, live, crow, mr_dev, salt_dev, slab,
+                    done_dev, depth_dev, cap_dev,
+                    statics=dict(g_cap=g_cap, span=span, ring=ring),
+                )
                 (st2, live2_d, crow2_d, slab2, done2_d, depth2_d,
                  ctrl_d, mnew_d, mgen_d, mabort_d, mins_d, mng_d,
                  rf_d) = progs.sstep(
                     st, live, crow, mr_dev, salt_dev, slab,
-                    jnp.asarray(done_pad), jnp.asarray(depth_pad),
-                    jnp.asarray(cap_pad),
+                    done_dev, depth_dev, cap_dev,
                     g_cap=g_cap, span=span, ring=ring,
                 )
                 self.stats["dispatches"] += 1
@@ -941,11 +953,19 @@ class BatchedChecker:
                     progs.note_shapes(
                         "fused", B, int(slab.shape[0]), g_cap
                     )
+                    done_dev = jnp.asarray(done_pad)
+                    # device-cost observatory (see the sstep site)
+                    graft_devprof.profile_program(
+                        "service.fused", progs.fused,
+                        st, live, crow, mr_dev, salt_dev, slab,
+                        done_dev,
+                        statics=dict(g_cap=g_cap),
+                    )
                     (slab2, children, bad_d, rows_d, fresh_d, fps_d,
                      gen_d, new_d, abort_d, ovf_d, ovfg_d,
                      n_g_dev) = progs.fused(
                         st, live, crow, mr_dev, salt_dev, slab,
-                        jnp.asarray(done_pad), g_cap=g_cap,
+                        done_dev, g_cap=g_cap,
                     )
                     (fresh_h, fps_h, gen_c, new_c, abort_c, ovf, ovf_g,
                      n_g_fused, bad_h, rows_h) = jax.device_get((
