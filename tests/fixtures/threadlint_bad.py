@@ -1,0 +1,78 @@
+"""Seeded graftsync violations — one per thread rule, each tagged with
+the rule it must trip (``# expect[GLxxx]``).  Never imported; exists
+only as lint input for tests/test_threadlint.py, which asserts every
+GL014-GL016 rule fires on its seeded line (the linter's own regression
+fixture, like graftlint_bad.py for GL001-GL009)."""
+
+import atexit
+import threading
+
+import jax
+
+
+class UnsyncedCounter:
+    """GL014: `hits` is written on the worker thread and read on the
+    main thread with no common lock and no registry entry."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+        self._thread = threading.Thread(target=self._work, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _work(self):
+        self.hits += 1  # expect[GL014]
+
+    def poll(self):
+        return self.hits
+
+
+class CrossedLocks:
+    """GL015: `ab` nests _a then _b, `ba` nests _b then _a — the
+    classic two-lock deadlock cycle."""
+
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def ab(self):
+        with self._a_lock:
+            with self._b_lock:  # expect[GL015]
+                pass
+
+    def ba(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+
+
+class GreedyHandler:
+    """GL016: the atexit handler takes a lock, starts a thread, and
+    touches jax — all three handler-discipline violations."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        atexit.register(self.on_exit)
+
+    def on_exit(self):
+        with self._lock:  # expect[GL016]
+            pass
+        t = threading.Thread(target=print)  # expect[GL016]
+        t.start()
+        jax.device_get(0)  # expect[GL016]
+
+
+class WaivedHandler:
+    """Waiver round-trip: the same lock take as GreedyHandler, excused
+    with a graftsync marker — must NOT fire."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        atexit.register(self.on_exit)
+
+    def on_exit(self):
+        # graftsync: waive[GL016]
+        with self._lock:
+            pass

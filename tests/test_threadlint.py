@@ -1,0 +1,458 @@
+"""graftsync subsystem tests (analysis/threadlint.py + analysis/tsan.py).
+
+Layer 1 (thread lint): every GL014-GL016 rule catches its seeded
+fixture violation, graftsync waivers and the shared baseline suppress
+findings, the lease-protocol audit holds on the real queue and flags a
+doctored one, and the repo itself is at a zero-unwaived-finding start
+against the committed sync registry (the CI `threads` gate, in-tree).
+Layer 2 (happens-before sanitizer): a barrier-forced two-thread race is
+caught deterministically with BOTH stacks in the report, every stdlib
+hand-off edge (start/join, lock, executor submit/result) suppresses the
+false positive it exists for, and a GRAFT_TSAN=1 tiny-config check run
+is bit-identical to the reference counts with zero race reports.
+
+Fast rows share one module-scope GRAFT_TSAN run; the subprocess
+composition row is @slow (tier-1 budget).
+"""
+
+import os
+import re
+import subprocess
+import sys
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from tla_raft_tpu.analysis import ast_lint, threadlint
+from tla_raft_tpu.analysis.__main__ import main as analysis_main
+from tla_raft_tpu.analysis.tsan import InstrumentedLock, TSan
+from tla_raft_tpu.config import RaftConfig
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+PKG = os.path.join(REPO, "tla_raft_tpu")
+FIXTURE = os.path.join(HERE, "fixtures", "threadlint_bad.py")
+
+S2 = RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1)
+
+
+def _lint_fixture():
+    with open(FIXTURE) as fh:
+        src = fh.read()
+    return src, threadlint.lint_source(
+        src, FIXTURE, "tests/fixtures/threadlint_bad.py", registry={}
+    )
+
+
+# -- layer 1: GL014-GL016 -------------------------------------------------
+
+def test_every_thread_rule_catches_its_seeded_violation():
+    src, findings = _lint_fixture()
+    expected: dict[str, set[int]] = {}  # rule -> expect[] marker lines
+    for i, line in enumerate(src.splitlines(), start=1):
+        for m in re.finditer(r"expect\[(GL\d+)\]", line):
+            expected.setdefault(m.group(1), set()).add(i)
+    assert set(expected) == set(threadlint.RULES), (
+        "fixture must seed all graftsync rules"
+    )
+    got = {(f.rule, f.line) for f in findings}
+    for rule, lines in expected.items():
+        for line in sorted(lines):
+            assert (rule, line) in got, (
+                f"{rule} not caught at fixture line {line}; findings:\n"
+                + "\n".join(f.format() for f in findings)
+            )
+
+
+def test_waived_handler_is_suppressed():
+    _src, findings = _lint_fixture()
+    # WaivedHandler's lock take carries a line-above graftsync waiver;
+    # the only GL016 findings must be GreedyHandler's
+    assert all(
+        "GreedyHandler" in f.message or "on_exit" not in f.message
+        for f in findings if f.rule == "GL016"
+    )
+    assert not any(
+        f.rule == "GL016" and "WaivedHandler" in f.message
+        for f in findings
+    )
+
+
+def test_graftlint_waiver_marker_does_not_suppress_graftsync():
+    src = (
+        "import atexit\n"
+        "import threading\n"
+        "class H:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        atexit.register(self.on_exit)\n"
+        "    def on_exit(self):\n"
+        "        # graftlint: waive[GL016]\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )
+    findings = threadlint.lint_source(src, "<mem>", "x.py", registry={})
+    assert any(f.rule == "GL016" for f in findings), (
+        "a graftlint marker must not excuse a graftsync finding"
+    )
+
+
+def test_gl014_common_lock_and_registry_suppress():
+    tpl = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0\n"
+        "        threading.Thread(target=self._work).start()\n"
+        "    def _work(self):\n"
+        "        {thr}\n"
+        "    def poll(self):\n"
+        "        {main}\n"
+    )
+    bare = tpl.format(thr="self.count += 1",
+                      main="return self.count")
+    locked = tpl.format(
+        thr="with self._lock:\n            self.count += 1",
+        main="with self._lock:\n            return self.count",
+    )
+    assert any(
+        f.rule == "GL014"
+        for f in threadlint.lint_source(bare, "<mem>", "x.py",
+                                        registry={})
+    )
+    assert not threadlint.lint_source(locked, "<mem>", "x.py",
+                                      registry={})
+    # a committed sync-registry entry is the third mechanism
+    reg = {"x.py::C.count": {"mechanism": "test", "proof": "test"}}
+    assert not threadlint.lint_source(bare, "<mem>", "x.py",
+                                      registry=reg)
+
+
+def test_gl016_flag_only_handler_passes():
+    src = (
+        "import atexit\n"
+        "class H:\n"
+        "    def __init__(self):\n"
+        "        self._done = False\n"
+        "        atexit.register(self.on_exit)\n"
+        "    def on_exit(self):\n"
+        "        self._done = True\n"
+    )
+    assert not threadlint.lint_source(src, "<mem>", "x.py", registry={})
+
+
+def test_gl016_covers_del_and_signal_handlers():
+    src = (
+        "import signal\n"
+        "import threading\n"
+        "_sig_lock = threading.Lock()\n"
+        "def on_sig(signum, frame):\n"
+        "    _sig_lock.acquire()\n"
+        "signal.signal(signal.SIGTERM, on_sig)\n"
+        "class R:\n"
+        "    def __del__(self):\n"
+        "        import jax\n"
+        "        jax.device_get(0)\n"
+    )
+    findings = threadlint.lint_source(src, "<mem>", "x.py", registry={})
+    rules = [f.rule for f in findings]
+    assert rules.count("GL016") >= 2, [f.format() for f in findings]
+
+
+def test_gl015_fires_via_lint_paths_and_is_ordered_clean_otherwise():
+    findings = threadlint.lint_paths([FIXTURE], root=HERE, registry={})
+    cycles = [f for f in findings if f.rule == "GL015"]
+    assert cycles, "fixture lock-order cycle must survive the merge"
+    assert "_a_lock" in cycles[0].message
+    assert "take sites:" in cycles[0].message
+    # consistent order in both functions -> no cycle
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()\n"
+        "        self._b_lock = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._a_lock:\n"
+        "            with self._b_lock:\n"
+        "                pass\n"
+        "    def g(self):\n"
+        "        with self._a_lock:\n"
+        "            with self._b_lock:\n"
+        "                pass\n"
+    )
+    assert not threadlint.lint_source(src, "<mem>", "x.py", registry={})
+
+
+def test_gl015_sees_locks_taken_by_callees():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()\n"
+        "        self._b_lock = threading.Lock()\n"
+        "    def _inner(self):\n"
+        "        with self._b_lock:\n"
+        "            pass\n"
+        "    def f(self):\n"
+        "        with self._a_lock:\n"
+        "            self._inner()\n"
+        "    def g(self):\n"
+        "        with self._b_lock:\n"
+        "            with self._a_lock:\n"
+        "                pass\n"
+    )
+    findings = threadlint.lint_source(src, "<mem>", "x.py", registry={})
+    assert any(f.rule == "GL015" for f in findings), (
+        "interprocedural acquire must contribute lock-order edges"
+    )
+
+
+def test_baseline_roundtrip_covers_threadlint_findings(tmp_path):
+    _src, findings = _lint_fixture()
+    assert findings
+    path = str(tmp_path / "baseline.json")
+    ast_lint.write_baseline(findings, path)
+    kept, suppressed = ast_lint.apply_baseline(
+        findings, ast_lint.load_baseline(path)
+    )
+    assert kept == []
+    assert suppressed == len(findings)
+    extra = ast_lint.Finding(
+        "GL014", "tla_raft_tpu/engine/pipeline.py", 1, 0, "m",
+        "self.new_field += 1",
+    )
+    kept2, _ = ast_lint.apply_baseline(
+        findings + [extra], ast_lint.load_baseline(path)
+    )
+    assert kept2 == [extra]
+
+
+def test_repo_is_at_zero_thread_finding_start():
+    """The acceptance gate, in-tree: the package thread-lints clean
+    against the committed sync registry (the CI `threads` job)."""
+    findings = threadlint.lint_paths([PKG], root=REPO)
+    assert findings == [], "unwaived graftsync findings:\n" + "\n".join(
+        f.format() for f in findings
+    )
+    assert threadlint.audit_lease_protocol(REPO) == []
+
+
+def test_sync_registry_is_load_bearing():
+    """Every committed registry entry covers a real boundary: with the
+    registry emptied the same tree must NOT lint clean."""
+    findings = threadlint.lint_paths([PKG], root=REPO, registry={})
+    assert any(f.rule == "GL014" for f in findings)
+    lease = threadlint.audit_lease_protocol(REPO, registry={})
+    assert any("lease::queue." in f for f in lease)
+    # and every entry carries its mechanism + proof
+    reg = threadlint.load_registry()
+    assert reg
+    for key, entry in reg.items():
+        assert entry.get("mechanism"), key
+        assert entry.get("proof"), key
+
+
+def test_lease_audit_flags_doctored_queue(tmp_path):
+    svc = tmp_path / "tla_raft_tpu" / "service"
+    svc.mkdir(parents=True)
+    (svc / "queue.py").write_text(
+        "class Q:\n"
+        "    def claim(self, j):\n"
+        "        return open(self._lease_path(j), 'w')\n"
+        "    def complete(self, j):\n"
+        "        self._set_state(j, 'done')\n"
+        "    def release(self, j):\n"
+        "        pass\n"
+        "    def requeue_stale(self):\n"
+        "        return []\n"
+    )
+    failures = threadlint.audit_lease_protocol(
+        str(tmp_path), registry={}
+    )
+    joined = "\n".join(failures)
+    assert "O_EXCL" in joined, failures
+    assert "unlink" in joined, failures
+    assert "requeue_stale" in joined, failures
+    assert "queue.complete()" in joined, failures
+    # the allowlist key named in the failure suppresses exactly it
+    reg = {"lease::queue.complete": {"mechanism": "m", "proof": "p"}}
+    failures2 = threadlint.audit_lease_protocol(str(tmp_path),
+                                                registry=reg)
+    assert not any("queue.complete()" in f for f in failures2)
+
+
+def test_cli_threads_arm():
+    assert analysis_main(["--threads"]) == 0
+    assert analysis_main(["--threads", "--no-threads"]) == 2
+    assert analysis_main(["--select", "GL015", "--no-jaxpr"]) == 0
+
+
+# -- layer 2: happens-before sanitizer ------------------------------------
+
+def _race_pair(ts):
+    """Two threads racing on one field with only a Barrier (which is NOT
+    a happens-before edge) between the accesses."""
+    b = threading.Barrier(2)
+
+    def worker():
+        ts.write("Shared", "f")
+        b.wait()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    b.wait()
+    return t
+
+
+def test_tsan_reports_barrier_forced_race_with_both_stacks():
+    with TSan(strict=False) as ts:
+        t = _race_pair(ts)
+        ts.write("Shared", "f")  # racing write, deterministically
+        t.join()
+    assert len(ts.races) == 1
+    r = ts.races[0]
+    assert r.field == "Shared.f"
+    text = r.format()
+    assert "writer stack (thread" in text
+    assert "racing write stack (thread" in text
+    assert "in worker" in text, "writer stack must show the write site"
+    assert not ts.ok
+    assert "Shared.f" in ts.report()["races"]
+
+
+def test_tsan_strict_raises_at_the_racing_access():
+    with TSan(strict=True) as ts:
+        t = _race_pair(ts)
+        with pytest.raises(RuntimeError, match="GRAFT_TSAN"):
+            ts.write("Shared", "f")
+        t.join()
+
+
+def test_tsan_join_is_a_happens_before_edge():
+    with TSan(strict=True) as ts:
+        def worker():
+            ts.write("Joined", "f")
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        ts.read("Joined", "f")  # ordered: start -> write -> join -> read
+        ts.write("Joined", "f")
+    assert ts.ok
+
+
+def test_instrumented_lock_orders_accesses_and_measures():
+    with TSan(strict=True) as ts:
+        lk = InstrumentedLock(ts, "test.L")
+        b = threading.Barrier(2)
+
+        def worker():
+            with lk:
+                ts.write("Locked", "f")
+            b.wait()
+
+        t = threading.Thread(target=worker)
+        # bypass the start() edge: hand the ORIGINAL start the thread so
+        # only the lock can order the accesses
+        orig_start = next(
+            o for obj, name, o in ts._orig
+            if obj is threading.Thread and name == "start"
+        )
+        orig_start(t)
+        b.wait()  # worker released lk; barrier is not an HB edge
+        with lk:
+            ts.read("Locked", "f")
+        t.join()
+    assert ts.ok, [r.field for r in ts.races]
+    st = ts.lock_stats["test.L"]
+    assert st["n"] == 2
+    assert st["held_s"] >= 0.0 and st["wait_s"] >= 0.0
+
+
+def test_tsan_executor_submit_result_edges():
+    with TSan(strict=True) as ts:
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            fut = ex.submit(lambda: ts.write("Task", "f"))
+            fut.result()
+            ts.read("Task", "f")  # ordered through the task_done token
+    assert ts.ok
+
+
+def test_tsan_disarm_restores_stdlib():
+    orig = (threading.Thread.start, threading.Event.set)
+    with TSan(strict=True):
+        assert threading.Thread.start is not orig[0]
+    assert threading.Thread.start is orig[0]
+    assert threading.Event.set is orig[1]
+
+
+# -- GRAFT_TSAN tiny-config smoke (shared module-scope run) ---------------
+
+@pytest.fixture(scope="module")
+def tsan_smoke():
+    """ONE in-process GRAFT_TSAN=1 reference run for every fast
+    assertion below (tier-1 budget: the subprocess variant is @slow)."""
+    from tla_raft_tpu.check import run_check
+
+    old = os.environ.get("GRAFT_TSAN")
+    os.environ["GRAFT_TSAN"] = "1"
+    try:
+        summary = run_check(S2, chunk=64)
+    finally:
+        if old is None:
+            os.environ.pop("GRAFT_TSAN", None)
+        else:
+            os.environ["GRAFT_TSAN"] = old
+    return summary
+
+
+def test_tsan_smoke_counts_bit_identical(tsan_smoke):
+    """Acceptance: instrumentation must not perturb the search."""
+    assert tsan_smoke["ok"] is True
+    assert tsan_smoke["distinct"] == 50
+    assert tsan_smoke["generated"] == 97
+    assert tsan_smoke["depth"] == 12
+
+
+def test_tsan_smoke_zero_races_and_lock_profile(tsan_smoke):
+    ts = tsan_smoke["_tsan"]
+    assert ts is not None, "GRAFT_TSAN=1 must arm the sanitizer"
+    assert ts.ok and ts.races == []
+    assert ts.lock_stats, "boundary locks must be instrumented"
+    assert any(
+        "TelemetryHub" in name for name in ts.lock_stats
+    ), sorted(ts.lock_stats)
+    assert all(st["n"] > 0 for st in ts.lock_stats.values())
+
+
+@pytest.mark.slow  # tier-1 budget: full subprocess composition row
+def test_tsan_composes_with_sanitizer_subprocess(tmp_path):
+    cfg = tmp_path / "tiny.cfg"
+    cfg.write_text(
+        "CONSTANTS\n"
+        "  Servers = {s1, s2}\n"
+        "  Vals = {v1}\n"
+        "  MaxElection = 1\n"
+        "  MaxRestart = 1\n"
+        "INIT Init\nNEXT Next\nINVARIANT Inv\n"
+    )
+    env = dict(os.environ)
+    env.update(GRAFT_TSAN="1", GRAFT_SANITIZE="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tla_raft_tpu.check",
+         "--config", str(cfg), "--chunk", "64",
+         "--log", str(tmp_path / "raft.log")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "TSan: armed" in proc.stdout
+    assert "TSan: OK" in proc.stdout
+    assert "0 race(s)." in proc.stdout
+    assert "Sanitizer: OK" in proc.stdout
+    # deterministic reference counts for this cfg: instrumentation must
+    # not perturb the search
+    assert "192 states generated, 99 distinct states found, depth 12." \
+        in proc.stdout

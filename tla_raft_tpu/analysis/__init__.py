@@ -20,8 +20,21 @@ rationale):
   a check run with a host-transfer ledger, a per-level compile-count
   ledger, and a worker-thread device-dispatch guard.
 
+Plus **graftsync**, the concurrency layer mirroring the same shape:
+
+* **thread lint** (:mod:`.threadlint`) — GL014 unsynced shared state
+  across thread boundaries (with the committed ``sync_registry.json``
+  ledger), GL015 static lock-order deadlock detection, GL016
+  signal/atexit/``__del__`` handler discipline, and the service
+  lease-protocol audit; waivable inline (``# graftsync: waive[RULE]``).
+* **happens-before sanitizer** (:mod:`.tsan`) — ``GRAFT_TSAN=1`` wraps
+  a check run with a vector-clock race checker over the known thread
+  boundaries plus a lock-hold/contention profiler publishing into the
+  telemetry hub.
+
 CLI: ``python -m tla_raft_tpu.analysis`` (exit 0 = zero unwaived
-findings and no ledger drift — the CI gate).
+findings and no ledger drift — the CI gate; 1 = findings/drift,
+2 = usage error).
 
 This module imports nothing heavier than stdlib so the package import
 stays device-free (tests/test_import_clean.py).

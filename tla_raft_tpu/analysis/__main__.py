@@ -1,14 +1,22 @@
 """CLI: ``python -m tla_raft_tpu.analysis`` — the graftlint gate.
 
-Default run = AST lint over the package (baseline applied) + jaxpr
-audit against the committed golden ledger.  Exit codes: 0 = clean,
-1 = unwaived findings or ledger drift, 2 = usage error.
+Default run = AST lint over the package (graftlint GL001-GL012 +
+graftsync GL014-GL016, baseline applied) + the service lease-protocol
+audit + jaxpr audit against the committed golden ledger.
+
+Exit codes:
+  0  clean — no unwaived findings, no audit failures
+  1  unwaived findings, lease-protocol failure, or ledger drift
+  2  usage error (unknown --select rule, missing --ledger file)
 
 Maintenance flows:
   --write-baseline   regenerate baseline.json from the current findings
                      (review the diff — it is the accepted-debt ledger)
   --write-ledger     regenerate golden_ledger.json from the current
                      kernel jaxprs (justify the drift in the PR)
+  --threads / --no-threads
+                     force the graftsync layer on/off (default: on;
+                     GL014-GL016 + lease audit, pure AST — no jax)
 """
 
 from __future__ import annotations
@@ -18,7 +26,7 @@ import json
 import os
 import sys
 
-from . import ast_lint, cost_audit, dispatch_audit, jaxpr_audit
+from . import ast_lint, cost_audit, dispatch_audit, jaxpr_audit, threadlint
 
 
 def main(argv=None) -> int:
@@ -37,6 +45,11 @@ def main(argv=None) -> int:
                    help="skip the GL013 per-kernel cost/memory budget "
                         "audit (compiles the registered kernels at the "
                         "tiny reference shapes; needs jax)")
+    p.add_argument("--threads", action="store_true",
+                   help="run ONLY the graftsync thread layer "
+                        "(GL014-GL016 + lease audit; pure AST)")
+    p.add_argument("--no-threads", action="store_true",
+                   help="skip the graftsync thread layer")
     p.add_argument("--no-baseline", action="store_true",
                    help="report baselined findings too")
     p.add_argument("--baseline", default=ast_lint.BASELINE_PATH,
@@ -53,12 +66,27 @@ def main(argv=None) -> int:
     root = os.path.dirname(pkg_dir)
     paths = args.paths or [pkg_dir]
     select = set(args.select) if args.select else None
-    unknown = (select or set()) - set(ast_lint.RULES)
+    unknown = (select or set()) - set(ast_lint.RULES) - set(threadlint.RULES)
     if unknown:
         print(f"unknown rule(s): {sorted(unknown)}", file=sys.stderr)
         return 2
+    if args.threads and args.no_threads:
+        print("--threads and --no-threads are exclusive", file=sys.stderr)
+        return 2
+    run_lint = not args.threads
+    run_threads = not args.no_threads
+    if select is not None:
+        run_lint = run_lint and bool(select & set(ast_lint.RULES))
+        run_threads = run_threads and bool(select & set(threadlint.RULES))
 
-    findings = ast_lint.lint_paths(paths, root=root, select=select)
+    findings = []
+    if run_lint:
+        findings += ast_lint.lint_paths(paths, root=root, select=select)
+    lease_failures: list[str] = []
+    if run_threads:
+        findings += threadlint.lint_paths(paths, root=root, select=select)
+        if select is None:
+            lease_failures = threadlint.audit_lease_protocol(root)
 
     if args.write_baseline:
         ast_lint.write_baseline(findings, args.baseline)
@@ -100,7 +128,8 @@ def main(argv=None) -> int:
             f"{cost_audit.COST_LEDGER_PATH}"
         )
         return 0
-    if not args.no_jaxpr:
+    run_jaxpr = not args.no_jaxpr and not args.threads
+    if run_jaxpr:
         golden = jaxpr_audit.load_golden(args.ledger)
         if golden is None and args.ledger != jaxpr_audit.LEDGER_PATH:
             # an explicit --ledger that doesn't exist is a usage error,
@@ -108,14 +137,14 @@ def main(argv=None) -> int:
             print(f"--ledger {args.ledger}: no such file", file=sys.stderr)
             return 2
         failures, warnings = jaxpr_audit.audit(golden)
-    if not args.no_jaxpr and not args.no_dispatch:
+    if run_jaxpr and not args.no_dispatch:
         # GL011: per-level device-dispatch budgets (fused + staged) —
         # measured engine runs, so it rides the same "needs jax" gate
         # as the jaxpr layer plus its own --no-dispatch opt-out
         d_fail, d_warn = dispatch_audit.audit()
         failures += d_fail
         warnings += d_warn
-    if not args.no_jaxpr and not args.no_cost:
+    if run_jaxpr and not args.no_cost:
         # GL013: per-kernel cost/memory budgets — compiled at the same
         # tiny reference shapes the jaxpr audit traces (needs jax)
         c_fail, c_warn = cost_audit.audit()
@@ -126,14 +155,17 @@ def main(argv=None) -> int:
         print(f.format())
     for w in warnings:
         print(f"warning: jaxpr-audit: {w}")
+    for f in lease_failures:
+        print(f"FAIL: {f}")
     for f in failures:
         print(f"FAIL: jaxpr-audit: {f}")
 
-    ok = not findings and not failures
+    ok = not findings and not failures and not lease_failures
     summary = dict(
         ok=ok,
         findings=len(findings),
         baselined=suppressed,
+        lease_failures=len(lease_failures),
         jaxpr_failures=len(failures),
         jaxpr_warnings=len(warnings),
     )
@@ -142,7 +174,8 @@ def main(argv=None) -> int:
     else:
         print(
             f"graftlint: {len(findings)} unwaived finding(s), "
-            f"{suppressed} baselined, {len(failures)} jaxpr failure(s), "
+            f"{suppressed} baselined, {len(lease_failures)} lease "
+            f"failure(s), {len(failures)} jaxpr failure(s), "
             f"{len(warnings)} warning(s) — "
             + ("OK" if ok else "FAIL")
         )

@@ -1,0 +1,942 @@
+"""graftsync layer 1: thread-boundary static analysis (GL014-GL016).
+
+graftlint (ast_lint.py) pins device hygiene; THIS module pins the host
+threads themselves.  Three rules over pure stdlib ``ast`` — no imports
+of the linted modules, same contract as graftlint:
+
+* **GL014 unsynced-shared-state** — extract every thread boundary in a
+  module (``threading.Thread`` targets, ``*pool*.submit/map``
+  callables, ``ThreadPoolExecutor`` initializers), compute the set of
+  functions reachable from the thread side, and build the shared-state
+  access map: attributes/module globals written on one side of a
+  boundary and touched on the other.  An access pair with no COMMON
+  lexical lock guard must be covered by a committed
+  ``sync_registry.json`` entry (``relpath::Class.attr`` with the
+  mechanism + one-line proof) or an inline waiver, else it hard-fails.
+* **GL015 lock-order-cycle** — build the global lock-order graph from
+  nested ``with lock:`` scopes (including locks taken by callees
+  resolved within the module) and hard-fail on cycles: a static
+  deadlock detector for the watchdog/hub/prewarmer lock set.
+* **GL016 handler-discipline** — ``atexit``/``signal`` handlers and
+  ``__del__`` bodies run at interpreter teardown or at arbitrary
+  bytecode boundaries; their call closure may set flags and flush
+  pre-bound buffers but may not take locks, start threads, or touch
+  jax.  Justified exceptions carry a waiver with the proof.
+
+Suppression mirrors graftlint but with its own marker so a waiver is
+always attributable to the layer that reviewed it:
+``# graftsync: waive[GL016]`` on the finding's line or the comment-only
+line above.  Baseline entries ride the same committed
+``analysis/baseline.json`` (key = ``rule|path|line-text``).
+
+The same module hosts the **service lease-protocol audit**
+(:func:`audit_lease_protocol`): a static state-machine check over
+``service/queue.py`` + ``service/daemon.py`` asserting every path out
+of a claimed lease releases it, poisons the job, or dies measurably
+(stale-lease requeue).  Allowlisted lease-free transitions live in the
+same sync registry under ``lease::`` keys.
+
+Known static limits, accepted deliberately: call resolution is
+module-local (cross-module attribute sharing is the runtime
+sanitizer's job — tsan.py), and guard detection is lexical ``with``
+nesting (a callee running entirely under a caller's lock documents
+that fact as a registry entry, which is the point: the invariant is
+written down where CI can hold it).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+from .ast_lint import Finding, _dotted, iter_py_files
+
+RULES = {
+    "GL014": "unsynced-shared-state: attribute/global crosses a thread "
+             "boundary without a common lock, queue hand-off, or "
+             "sync_registry entry",
+    "GL015": "lock-order-cycle: nested `with lock:` scopes form a "
+             "cycle in the global lock-order graph (static deadlock)",
+    "GL016": "handler-discipline: signal/atexit/__del__ closure takes "
+             "a lock, starts a thread, or touches jax",
+}
+
+REGISTRY_PATH = os.path.join(
+    os.path.dirname(__file__), "sync_registry.json"
+)
+
+_WAIVE_RE = re.compile(r"graftsync:\s*waive\[([A-Za-z0-9*,\s]+)\]")
+# attribute/variable names that ARE synchronization objects — excluded
+# from shared-state tracking (the lock is the mechanism, not the data)
+_LOCK_NAME_RE = re.compile(
+    r"lock|mutex|cond|(^|_)cv($|_)|sem($|aphore)", re.IGNORECASE
+)
+_POOL_OWNER_RE = re.compile(r"pool|executor", re.IGNORECASE)
+# threading/queue constructors whose instances are sync objects; an
+# attribute bound to one in __init__ is excluded from shared state
+_SYNC_CTORS = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Queue", "SimpleQueue", "LifoQueue",
+}
+# method calls that mutate their receiver — a Load of the receiver
+# attribute plus one of these is a WRITE for race purposes
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "remove", "clear", "add", "discard", "update", "setdefault",
+    "sort", "reverse", "put", "put_nowait",
+}
+
+
+class _FuncInfo:
+    __slots__ = ("node", "name", "cls", "parent")
+
+    def __init__(self, node, name, cls, parent):
+        self.node = node
+        self.name = name
+        self.cls = cls        # enclosing class name or None
+        self.parent = parent  # enclosing _FuncInfo or None
+
+    @property
+    def qual(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+class _Access:
+    __slots__ = ("owner", "name", "write", "held", "node", "fi")
+
+    def __init__(self, owner, name, write, held, node, fi):
+        self.owner = owner    # class name for self attrs, None for globals
+        self.name = name
+        self.write = write
+        self.held = held      # frozenset of lock tokens
+        self.node = node
+        self.fi = fi
+
+
+class _ModuleThreads:
+    """Per-module thread-boundary model: functions, entries, accesses,
+    lock scopes.  One instance per linted file."""
+
+    def __init__(self, src: str, path: str, relpath: str):
+        self.src = src
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        self.findings: list[Finding] = []
+
+        self.funcs: dict[int, _FuncInfo] = {}
+        self.methods: dict[tuple[str, str], _FuncInfo] = {}
+        self.module_funcs: dict[str, _FuncInfo] = {}
+        self.class_names: set[str] = set()
+        self._collect_funcs(self.tree, None, None)
+
+        self.module_globals = self._module_globals()
+        self.sync_attrs = self._sync_attrs()
+        self.pool_bound = self._pool_bound_names()
+
+        # (kind, entry _FuncInfo) — thread-side roots and handler roots
+        self.thread_entries: list[tuple[str, _FuncInfo]] = []
+        self.handler_entries: list[tuple[str, _FuncInfo]] = []
+        self._find_entries()
+        self.thread_closure = self._closure(
+            [fi for _, fi in self.thread_entries]
+        )
+
+        self.accesses: list[_Access] = []
+        self._acq_memo: dict[int, set[str]] = {}
+        for fi in self.funcs.values():
+            self._walk_accesses(fi, fi.node, frozenset())
+
+    # -- structure --------------------------------------------------------
+
+    def _collect_funcs(self, node, cls, parent):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self.class_names.add(child.name)
+                self._collect_funcs(child, child.name, None)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = _FuncInfo(child, child.name, cls, parent)
+                self.funcs[id(child)] = fi
+                if cls and parent is None:
+                    self.methods.setdefault((cls, child.name), fi)
+                elif cls is None and parent is None:
+                    self.module_funcs.setdefault(child.name, fi)
+                self._collect_funcs(child, cls, fi)
+            else:
+                self._collect_funcs(child, cls, parent)
+
+    def _module_globals(self) -> set[str]:
+        out: set[str] = set()
+        for node in self.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        return out
+
+    def _sync_attrs(self) -> dict[str, set[str]]:
+        """class -> attribute names bound to a sync-object constructor."""
+        out: dict[str, set[str]] = {}
+        for fi in self.funcs.values():
+            if fi.cls is None:
+                continue
+            for node in ast.walk(fi.node):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = node.value
+                if not isinstance(value, ast.Call):
+                    continue
+                d = _dotted(value.func)
+                if not d or d.split(".")[-1] not in _SYNC_CTORS:
+                    continue
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        out.setdefault(fi.cls, set()).add(t.attr)
+        return out
+
+    def _pool_bound_names(self) -> set[str]:
+        """Names bound to an executor constructor (the `as ex:` idiom)."""
+        bound: set[str] = set()
+
+        def ctor(call) -> bool:
+            if not isinstance(call, ast.Call):
+                return False
+            d = _dotted(call.func)
+            return bool(d) and d.split(".")[-1] in (
+                "ThreadPoolExecutor", "ProcessPoolExecutor",
+            )
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if ctor(item.context_expr) and isinstance(
+                        item.optional_vars, ast.Name
+                    ):
+                        bound.add(item.optional_vars.id)
+            elif isinstance(node, ast.Assign) and ctor(node.value):
+                for t in node.targets:
+                    d = _dotted(t)
+                    if d:
+                        bound.add(d.split(".")[-1])
+        return bound
+
+    def _enclosing(self, node) -> _FuncInfo | None:
+        """The innermost _FuncInfo whose body contains ``node``."""
+        best = None
+        best_span = None
+        for fi in self.funcs.values():
+            f = fi.node
+            if (
+                f.lineno <= node.lineno
+                and node.lineno <= (f.end_lineno or f.lineno)
+            ):
+                span = (f.end_lineno or f.lineno) - f.lineno
+                if best is None or span < best_span:
+                    best, best_span = fi, span
+        return best
+
+    def _resolve(self, ref, caller: _FuncInfo | None) -> _FuncInfo | None:
+        """Resolve a callable reference to a module-local function."""
+        if isinstance(ref, ast.Attribute):
+            if (
+                isinstance(ref.value, ast.Name)
+                and ref.value.id == "self"
+                and caller is not None and caller.cls
+            ):
+                return self.methods.get((caller.cls, ref.attr))
+            return None
+        if isinstance(ref, ast.Name):
+            fi = caller
+            while fi is not None:
+                for cand in self.funcs.values():
+                    if cand.parent is fi and cand.name == ref.id:
+                        return cand
+                fi = fi.parent
+            return self.module_funcs.get(ref.id)
+        return None
+
+    # -- boundaries -------------------------------------------------------
+
+    def _find_entries(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func) or ""
+            last = d.split(".")[-1]
+            caller = self._enclosing(node)
+            if last == "Thread" and d in ("Thread", "threading.Thread"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        fi = self._resolve(kw.value, caller)
+                        if fi is not None:
+                            self.thread_entries.append(("thread", fi))
+            elif last in ("ThreadPoolExecutor", "ProcessPoolExecutor"):
+                for kw in node.keywords:
+                    if kw.arg == "initializer":
+                        fi = self._resolve(kw.value, caller)
+                        if fi is not None:
+                            self.thread_entries.append(("initializer", fi))
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("submit", "map")
+            ):
+                owner = _dotted(node.func.value) or ""
+                if (
+                    _POOL_OWNER_RE.search(owner)
+                    or owner.split(".")[-1] in self.pool_bound
+                ) and node.args:
+                    fi = self._resolve(node.args[0], caller)
+                    if fi is not None:
+                        self.thread_entries.append(("pool", fi))
+            elif d == "atexit.register" and node.args:
+                fi = self._resolve(node.args[0], caller)
+                if fi is not None:
+                    self.handler_entries.append(("atexit", fi))
+            elif d == "signal.signal" and len(node.args) >= 2:
+                fi = self._resolve(node.args[1], caller)
+                if fi is not None:
+                    self.handler_entries.append(("signal", fi))
+        for (cls, name), fi in self.methods.items():
+            if name == "__del__":
+                self.handler_entries.append(("__del__", fi))
+
+    def _closure(self, roots: list[_FuncInfo]) -> set[int]:
+        seen: set[int] = set()
+        queue = list(roots)
+        while queue:
+            fi = queue.pop()
+            if id(fi.node) in seen:
+                continue
+            seen.add(id(fi.node))
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call):
+                    callee = self._resolve(node.func, fi)
+                    if callee is not None and id(callee.node) not in seen:
+                        queue.append(callee)
+        return seen
+
+    # -- lock scopes + accesses ------------------------------------------
+
+    def _lock_token(self, expr, fi: _FuncInfo) -> str | None:
+        """Normalized lock identity for a `with` context expression."""
+        d = _dotted(expr)
+        if not d:
+            return None
+        if d.startswith("self."):
+            attr = d[5:]
+            cls = fi.cls or "?"
+            if _LOCK_NAME_RE.search(attr) or attr in self.sync_attrs.get(
+                cls, ()
+            ):
+                return f"{self.relpath}::{cls}.{attr}"
+            return None
+        name = d.split(".")[-1]
+        if _LOCK_NAME_RE.search(name):
+            return f"{self.relpath}::{d}"
+        return None
+
+    def _is_lock_name(self, owner_cls: str | None, attr: str) -> bool:
+        if _LOCK_NAME_RE.search(attr):
+            return True
+        if owner_cls is not None:
+            return attr in self.sync_attrs.get(owner_cls, ())
+        return False
+
+    def _walk_accesses(self, fi: _FuncInfo, node, held: frozenset):
+        if isinstance(node, ast.With):
+            tokens = set()
+            for item in node.items:
+                self._walk_accesses(fi, item.context_expr, held)
+                tok = self._lock_token(item.context_expr, fi)
+                if tok:
+                    tokens.add(tok)
+            inner = frozenset(held | tokens)
+            for b in node.body:
+                self._walk_accesses(fi, b, inner)
+            return
+        self._record(fi, node, held)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue  # separate scope (own _FuncInfo / class body)
+            self._walk_accesses(fi, child, held)
+
+    def _record(self, fi: _FuncInfo, node, held: frozenset):
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            owner = None
+            if isinstance(base, ast.Name) and base.id == "self":
+                owner = fi.cls
+            elif (
+                isinstance(base, ast.Name)
+                and base.id in self.class_names
+            ):
+                owner = base.id
+            if owner is None:
+                return
+            if self._is_lock_name(owner, node.attr):
+                return
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self.accesses.append(
+                _Access(owner, node.attr, write, held, node, fi)
+            )
+        elif isinstance(node, ast.Call):
+            # receiver-mutating method call: self.x.append(...) writes x
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _MUTATORS
+                and isinstance(f.value, ast.Attribute)
+            ):
+                recv = f.value
+                if (
+                    isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"
+                    and fi.cls
+                    and not self._is_lock_name(fi.cls, recv.attr)
+                ):
+                    self.accesses.append(
+                        _Access(fi.cls, recv.attr, True, held, node, fi)
+                    )
+            elif (
+                isinstance(f, ast.Attribute)
+                and f.attr in _MUTATORS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in self.module_globals
+            ):
+                self.accesses.append(
+                    _Access(None, f.value.id, True, held, node, fi)
+                )
+        elif isinstance(node, ast.Subscript):
+            # _FLAGS["x"] = ... mutates the module-global dict
+            if (
+                isinstance(node.ctx, (ast.Store, ast.Del))
+                and isinstance(node.value, ast.Name)
+                and node.value.id in self.module_globals
+            ):
+                self.accesses.append(
+                    _Access(None, node.value.id, True, held, node, fi)
+                )
+        elif isinstance(node, ast.Name):
+            if node.id in self.module_globals:
+                write = isinstance(node.ctx, (ast.Store, ast.Del))
+                if write and not self._declares_global(fi, node.id):
+                    return  # local shadowing the module name
+                self.accesses.append(
+                    _Access(None, node.id, write, held, node, fi)
+                )
+
+    def _declares_global(self, fi: _FuncInfo, name: str) -> bool:
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Global) and name in node.names:
+                return True
+        return False
+
+    # -- GL014 ------------------------------------------------------------
+
+    def gl014(self, registry: dict) -> None:
+        if not self.thread_entries:
+            return
+        entry_names = sorted({fi.qual for _, fi in self.thread_entries})
+        by_key: dict[tuple, list[_Access]] = {}
+        for a in self.accesses:
+            if a.fi.name == "__init__" and a.owner == a.fi.cls:
+                continue  # publication before the thread exists
+            by_key.setdefault((a.owner, a.name), []).append(a)
+        for (owner, name), accs in sorted(
+            by_key.items(), key=lambda kv: (str(kv[0][0]), kv[0][1])
+        ):
+            thr = [a for a in accs if id(a.fi.node) in self.thread_closure]
+            main = [
+                a for a in accs if id(a.fi.node) not in self.thread_closure
+            ]
+            if not thr or not main:
+                continue
+            if not (
+                any(a.write for a in thr) or any(a.write for a in main)
+            ):
+                continue  # read-only after publication
+            common = frozenset.intersection(*(a.held for a in accs))
+            if common:
+                continue  # every access under one shared lock
+            what = f"{owner}.{name}" if owner else name
+            key = f"{self.relpath}::{what}"
+            if key in registry:
+                continue
+            anchor = next((a for a in accs if not a.held), accs[0])
+            self.findings.append(self._finding(
+                "GL014", anchor.node,
+                f"`{what}` is written across a thread boundary (entries: "
+                f"{', '.join(entry_names)}) with no common lock — guard "
+                f"every access with one lock, hand it off through a "
+                f"queue, or add a sync_registry entry `{key}` with the "
+                f"mechanism and proof",
+            ))
+
+    # -- GL015 ------------------------------------------------------------
+
+    def _acquires(self, fi: _FuncInfo, stack: set[int]) -> set[str]:
+        """Lock tokens fi (or a same-module callee) may take."""
+        if id(fi.node) in self._acq_memo:
+            return self._acq_memo[id(fi.node)]
+        if id(fi.node) in stack:
+            return set()
+        stack.add(id(fi.node))
+        out: set[str] = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    tok = self._lock_token(item.context_expr, fi)
+                    if tok:
+                        out.add(tok)
+            elif isinstance(node, ast.Call):
+                callee = self._resolve(node.func, fi)
+                if callee is not None:
+                    out |= self._acquires(callee, stack)
+        stack.discard(id(fi.node))
+        self._acq_memo[id(fi.node)] = out
+        return out
+
+    def lock_edges(self) -> dict[tuple[str, str], tuple[str, int, str]]:
+        """(held, taken) -> (relpath, line, stripped-line) anchors."""
+        edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+
+        def note(a: str, b: str, node):
+            if a == b:
+                return
+            if (a, b) not in edges:
+                text = ""
+                if 1 <= node.lineno <= len(self.lines):
+                    text = self.lines[node.lineno - 1].strip()
+                edges[(a, b)] = (self.relpath, node.lineno, text)
+
+        def walk(fi, node, held):
+            if isinstance(node, ast.With):
+                tokens = set()
+                for item in node.items:
+                    tok = self._lock_token(item.context_expr, fi)
+                    if tok:
+                        tokens.add(tok)
+                        for h in held:
+                            note(h, tok, node)
+                for b in node.body:
+                    walk(fi, b, held | tokens)
+                return
+            if isinstance(node, ast.Call) and held:
+                callee = self._resolve(node.func, fi)
+                if callee is not None:
+                    for tok in self._acquires(callee, set()):
+                        for h in held:
+                            note(h, tok, node)
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                    tok = self._lock_token(f.value, fi)
+                    if tok:
+                        for h in held:
+                            note(h, tok, node)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                    continue
+                walk(fi, child, held)
+
+        for fi in self.funcs.values():
+            if fi.parent is None:
+                walk(fi, fi.node, frozenset())
+        return edges
+
+    # -- GL016 ------------------------------------------------------------
+
+    def gl016(self) -> None:
+        for kind, entry in self.handler_entries:
+            closure = self._closure([entry])
+            for fi in self.funcs.values():
+                if id(fi.node) not in closure:
+                    continue
+                self._gl016_scan(kind, entry, fi)
+
+    def _gl016_scan(self, kind: str, entry: _FuncInfo, fi: _FuncInfo):
+        where = (
+            f"`{fi.qual}` (reached from {kind} handler `{entry.qual}`)"
+            if fi is not entry else f"{kind} handler `{entry.qual}`"
+        )
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    tok = self._lock_token(item.context_expr, fi)
+                    if tok:
+                        self.findings.append(self._finding(
+                            "GL016", item.context_expr,
+                            f"{where} takes `{tok.split('::')[-1]}` — a "
+                            "handler blocking on a lock the interrupted "
+                            "thread holds deadlocks teardown; set a "
+                            "flag instead, or waive with the proof the "
+                            "holder always releases",
+                        ))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                d = _dotted(f) or ""
+                if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                    tok = self._lock_token(f.value, fi)
+                    if tok:
+                        self.findings.append(self._finding(
+                            "GL016", node,
+                            f"{where} calls `.acquire()` on "
+                            f"`{tok.split('::')[-1]}` — handlers must "
+                            "not block on locks",
+                        ))
+                elif d in ("Thread", "threading.Thread"):
+                    self.findings.append(self._finding(
+                        "GL016", node,
+                        f"{where} starts a thread — interpreter "
+                        "teardown will not wait for it; handlers may "
+                        "only flush pre-bound state",
+                    ))
+                elif d and d.split(".")[0] in ("jax", "jnp"):
+                    self.findings.append(self._finding(
+                        "GL016", node,
+                        f"{where} touches `{d}` — device work from a "
+                        "handler re-enters a runtime that may already "
+                        "be tearing down",
+                    ))
+
+    # -- shared -----------------------------------------------------------
+
+    def _finding(self, rule: str, node, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        text = ""
+        if 1 <= line <= len(self.lines):
+            text = self.lines[line - 1].strip()
+        return Finding(rule, self.relpath, line, col, message, text)
+
+    def apply_waivers(self, findings: list[Finding]) -> list[Finding]:
+        waivers: dict[int, set[str]] = {}
+        comment_only: set[int] = set()
+        for i, line in enumerate(self.lines, start=1):
+            m = _WAIVE_RE.search(line)
+            if m:
+                waivers[i] = {t.strip() for t in m.group(1).split(",")}
+                if line.strip().startswith("#"):
+                    comment_only.add(i)
+        if not waivers:
+            return findings
+
+        def waived(f: Finding) -> bool:
+            rules = waivers.get(f.line)
+            if rules and (f.rule in rules or "*" in rules):
+                return True
+            if f.line - 1 in comment_only:
+                rules = waivers[f.line - 1]
+                return f.rule in rules or "*" in rules
+            return False
+
+        return [f for f in findings if not waived(f)]
+
+
+# -- registry -------------------------------------------------------------
+
+def load_registry(path: str = REGISTRY_PATH) -> dict[str, dict]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return dict(data.get("entries", {}))
+
+
+# -- driver ---------------------------------------------------------------
+
+def lint_source(
+    src: str, path: str = "<string>", relpath: str | None = None,
+    select: set[str] | None = None, registry: dict | None = None,
+) -> list[Finding]:
+    """Lint ONE module (GL014 + GL016 + module-local GL015 cycles);
+    graftsync waivers applied, baseline NOT applied."""
+    mod = _ModuleThreads(src, path, relpath or path)
+    reg = load_registry() if registry is None else registry
+    if select is None or "GL014" in select:
+        mod.gl014(reg)
+    if select is None or "GL016" in select:
+        mod.gl016()
+    findings = list(mod.findings)
+    if select is None or "GL015" in select:
+        findings += _cycle_findings(mod.lock_edges())
+    return mod.apply_waivers(findings)
+
+
+def _cycle_findings(
+    edges: dict[tuple[str, str], tuple[str, int, str]]
+) -> list[Finding]:
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    seen_cycles: set[frozenset] = set()
+    findings: list[Finding] = []
+
+    def dfs(node, stack, on_stack, visited):
+        visited.add(node)
+        on_stack.add(node)
+        stack.append(node)
+        for nxt in sorted(graph[node]):
+            if nxt in on_stack:
+                cycle = stack[stack.index(nxt):] + [nxt]
+                key = frozenset(cycle)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    findings.append(_cycle_finding(cycle, edges))
+            elif nxt not in visited:
+                dfs(nxt, stack, on_stack, visited)
+        stack.pop()
+        on_stack.discard(node)
+
+    visited: set[str] = set()
+    for node in sorted(graph):
+        if node not in visited:
+            dfs(node, [], set(), visited)
+    return findings
+
+
+def _cycle_finding(cycle, edges) -> Finding:
+    pairs = list(zip(cycle, cycle[1:]))
+    anchors = [edges[p] for p in pairs if p in edges]
+    path, line, text = min(anchors) if anchors else ("<unknown>", 1, "")
+    pretty = " -> ".join(n.split("::")[-1] for n in cycle)
+    sites = ", ".join(f"{p}:{ln}" for p, ln, _ in sorted(anchors))
+    return Finding(
+        "GL015", path, line, 0,
+        f"lock-order cycle {pretty} (take sites: {sites}) — two "
+        "threads entering from opposite ends deadlock; impose one "
+        "global order or narrow a critical section",
+        text,
+    )
+
+
+def lint_paths(
+    paths: list[str], root: str | None = None,
+    select: set[str] | None = None, registry: dict | None = None,
+) -> list[Finding]:
+    """Lint files/trees with the full cross-module GL015 graph."""
+    if root is None:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    reg = load_registry() if registry is None else registry
+    findings: list[Finding] = []
+    mods: list[_ModuleThreads] = []
+    for f in iter_py_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        rel = os.path.relpath(os.path.abspath(f), root)
+        mod = _ModuleThreads(src, f, rel)
+        if select is None or "GL014" in select:
+            mod.gl014(reg)
+        if select is None or "GL016" in select:
+            mod.gl016()
+        mods.append(mod)
+    if select is None or "GL015" in select:
+        edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+        for mod in mods:
+            for k, v in mod.lock_edges().items():
+                edges.setdefault(k, v)
+        by_path = {m.relpath: m for m in mods}
+        for f in _cycle_findings(edges):
+            anchor = by_path.get(f.path)
+            if anchor is None or anchor.apply_waivers([f]):
+                findings.append(f)
+    for mod in mods:
+        findings.extend(mod.apply_waivers(mod.findings))
+    return findings
+
+
+# -- service lease-protocol audit ----------------------------------------
+
+_TERMINAL_STATES = {"done", "failed", "submitted"}
+
+
+def audit_lease_protocol(
+    root: str | None = None, registry: dict | None = None,
+) -> list[str]:
+    """Static state-machine audit of the job-queue lease protocol.
+
+    Asserts the structural invariants every fleet worker's liveness
+    rests on: claims are exclusive (O_EXCL), every terminal transition
+    out of a claimed lease releases the lease file (or is an
+    allowlisted lease-free transition under a ``lease::`` registry
+    key), stale leases are measurably requeued or poisoned, and every
+    daemon-side claim/preemption path releases what it claimed.
+    Returns a list of failure strings (empty = protocol holds).
+    """
+    if root is None:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    reg = load_registry() if registry is None else registry
+    failures: list[str] = []
+
+    qpath = os.path.join(root, "tla_raft_tpu", "service", "queue.py")
+    dpath = os.path.join(root, "tla_raft_tpu", "service", "daemon.py")
+    if not os.path.exists(qpath):
+        return [f"lease-audit: {qpath} missing"]
+
+    with open(qpath, encoding="utf-8") as fh:
+        qtree = ast.parse(fh.read(), filename=qpath)
+    methods = _class_methods(qtree)
+
+    def has_call(fn, dotted_suffix: str) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func) or ""
+                if d == dotted_suffix or d.endswith("." + dotted_suffix):
+                    return True
+        return False
+
+    def mentions(fn, name: str) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) and node.attr == name:
+                return True
+            if isinstance(node, ast.Name) and node.id == name:
+                return True
+            if isinstance(node, ast.Constant) and node.value == name:
+                return True
+        return False
+
+    claim = methods.get("claim")
+    if claim is None:
+        failures.append("lease-audit: queue has no claim() method")
+    else:
+        excl = any(
+            isinstance(n, ast.Attribute) and n.attr == "O_EXCL"
+            for n in ast.walk(claim)
+        )
+        if not excl:
+            failures.append(
+                "lease-audit: claim() does not create the lease with "
+                "os.O_EXCL — two workers can claim one job"
+            )
+    for name in ("complete", "release"):
+        fn = methods.get(name)
+        if fn is None:
+            failures.append(f"lease-audit: queue has no {name}() method")
+        elif not (mentions(fn, "_lease_path") and has_call(fn, "unlink")):
+            failures.append(
+                f"lease-audit: {name}() does not unlink the lease — a "
+                "finished job would pin its claim forever"
+            )
+    rq = methods.get("requeue_stale")
+    if rq is None:
+        failures.append("lease-audit: queue has no requeue_stale()")
+    else:
+        if not mentions(rq, "_poison"):
+            failures.append(
+                "lease-audit: requeue_stale() never poisons — a "
+                "crash-looping job would requeue forever"
+            )
+        if not mentions(rq, "max_attempts"):
+            failures.append(
+                "lease-audit: requeue_stale() ignores max_attempts"
+            )
+    poison = methods.get("_poison")
+    if poison is not None and not mentions(poison, "failed"):
+        failures.append(
+            "lease-audit: _poison() does not record the 'failed' state"
+        )
+
+    # terminal _set_state transitions must also touch the lease (or be
+    # allowlisted as lease-free under a `lease::` registry key)
+    for name, fn in methods.items():
+        if name == "_set_state":
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func) or ""
+            if not d.endswith("_set_state"):
+                continue
+            states = [
+                a.value for a in node.args
+                if isinstance(a, ast.Constant)
+                and a.value in _TERMINAL_STATES
+            ]
+            if not states:
+                continue
+            key = f"lease::queue.{name}"
+            if key in reg:
+                continue
+            if mentions(fn, "_lease_path") or has_call(fn, "unlink"):
+                continue
+            failures.append(
+                f"lease-audit: queue.{name}() moves a job to "
+                f"{states[0]!r} without touching its lease — add the "
+                f"release/unlink, or allowlist `{key}` in "
+                "sync_registry.json with the proof no lease exists"
+            )
+
+    if os.path.exists(dpath):
+        with open(dpath, encoding="utf-8") as fh:
+            dtree = ast.parse(fh.read(), filename=dpath)
+        dmethods = _class_methods(dtree)
+        for name, fn in dmethods.items():
+            if not has_call(fn, "claim"):
+                continue
+            key = f"lease::daemon.{name}"
+            if key in reg:
+                continue
+            if not (
+                has_call(fn, "complete") or has_call(fn, "release")
+                or has_call(fn, "_run_one")
+            ):
+                failures.append(
+                    f"lease-audit: daemon.{name}() claims but has no "
+                    "complete/release path — a worker crash there "
+                    "strands the lease until staleness"
+                )
+        for name, fn in dmethods.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                t = node.type
+                names = []
+                for sub in ast.walk(t) if t is not None else []:
+                    d = _dotted(sub)
+                    if d:
+                        names.append(d.split(".")[-1])
+                if "Preempted" not in names:
+                    continue
+                if not any(
+                    isinstance(c, ast.Call)
+                    and (_dotted(c.func) or "").endswith("release")
+                    for b in node.body for c in ast.walk(b)
+                ) and has_call(fn, "claim"):
+                    failures.append(
+                        f"lease-audit: daemon.{name}() catches "
+                        "Preempted after claiming without releasing — "
+                        "the preempted worker strands its lease"
+                    )
+    return failures
+
+
+def _class_methods(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    out: dict[str, ast.FunctionDef] = {}
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.setdefault(sub.name, sub)
+    return out

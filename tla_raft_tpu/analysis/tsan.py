@@ -1,0 +1,486 @@
+"""graftsync layer 2: runtime happens-before sanitizer (GRAFT_TSAN=1).
+
+Where threadlint.py proves thread soundness STATICALLY, this module
+checks it on a live run: a lightweight vector-clock checker over the
+checker's known boundary objects.  Armed via ``GRAFT_TSAN=1`` in
+check.py (composing with ``GRAFT_SANITIZE``), it
+
+* patches the stdlib synchronization primitives the runtime uses —
+  ``Thread.start/join``, ``Event.set/wait``, executor
+  ``submit``/``Future.result``, ``Queue.put/get`` — so every hand-off
+  creates a happens-before edge between the participating threads'
+  vector clocks;
+* swaps the known boundary locks (Prewarmer ``_lock``, Watchdog
+  ``_cv``'s lock, TelemetryHub ``_lock``/``_io_lock``) for
+  :class:`InstrumentedLock`, which adds acquire/release edges AND
+  measures per-lock wait/hold times (the contention profiler);
+* instruments the known cross-thread fields (``AsyncFetchWindow.live``,
+  ``Watchdog.fired``) with explicit :meth:`TSan.read`/:meth:`write`
+  records: an access not ordered after the previous write by ANY
+  happens-before chain is a race, reported with both stacks — the
+  writer's (captured at write time) and the racing accessor's.
+
+Lock statistics publish into the telemetry hub at disarm as one
+``lock_held`` event per lock (GL012-clean: collection at a choke
+point, obs/ renders); an individual acquire that waits longer than
+``WAIT_EVENT_S`` publishes a ``lock_wait`` contention event at the
+site (hub-internal locks are aggregate-only — a hub lock emitting
+about itself would recurse).
+
+The checker is intentionally conservative in the safe direction for a
+PROFILER: per-queue (not per-item) queue edges can only create extra
+order, never report a false race.  Strictness is the caller's choice:
+``strict=True`` (the default, used by tests) raises at the racing
+access; check.py arms with ``strict=False`` and fails the run at exit
+(exit code 3, the runtime-hygiene class) so a race report never
+truncates the counts that prove it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import traceback
+
+# an acquire that blocks longer than this publishes a `lock_wait`
+# contention event at the site (aggregates are always collected)
+WAIT_EVENT_S = 0.005
+
+
+class Race:
+    """One unordered cross-thread access, with both stacks."""
+
+    def __init__(self, field, w_tid, w_stack, a_tid, a_stack, kind):
+        self.field = field
+        self.w_tid = w_tid
+        self.w_stack = w_stack
+        self.a_tid = a_tid
+        self.a_stack = a_stack
+        self.kind = kind  # "read" | "write" — the racing access
+
+    def format(self) -> str:
+        return (
+            f"data race on {self.field}: {self.kind} on thread "
+            f"{self.a_tid} not ordered after write on thread "
+            f"{self.w_tid}\n"
+            f"  -- writer stack (thread {self.w_tid}) --\n"
+            f"{self.w_stack}"
+            f"  -- racing {self.kind} stack (thread {self.a_tid}) --\n"
+            f"{self.a_stack}"
+        )
+
+
+class InstrumentedLock:
+    """Drop-in ``threading.Lock`` wrapper: happens-before edges through
+    the lock token plus wait/hold measurement.  Also serves as the
+    inner lock of a ``threading.Condition`` (wait/notify then inherit
+    the edges through the release/re-acquire pairs)."""
+
+    def __init__(self, tsan: "TSan", name: str, publish_waits=True):
+        self._inner = threading.Lock()
+        self._tsan = tsan
+        self.name = name
+        self._publish_waits = publish_waits
+        self._t_acq = 0.0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        t0 = time.monotonic()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            t1 = time.monotonic()
+            self._t_acq = t1
+            self._tsan._lock_acquired(self, t1 - t0)
+        return ok
+
+    def release(self):
+        held = time.monotonic() - self._t_acq
+        self._tsan._lock_released(self, held)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class TSan:
+    """Happens-before sanitizer + lock contention profiler.
+
+    Use as a context manager around the run (check.py) or arm/disarm
+    explicitly (tests).  All clock state lives behind one raw internal
+    lock; the instrumented program only ever calls into short O(1)
+    critical sections.
+    """
+
+    def __init__(self, strict: bool = True):
+        self.strict = strict
+        self.races: list[Race] = []
+        self.lock_stats: dict[str, dict] = {}
+        self._mu = threading.Lock()
+        self._clocks: dict[int, dict[int, int]] = {}
+        self._sync: dict[object, dict[int, int]] = {}
+        # field -> (writer tid, writer epoch, writer stack)
+        self._writes: dict[object, tuple[int, int, str]] = {}
+        self._reported: set[object] = set()
+        self._task_seq = 0
+        self._orig: list[tuple] = []
+        self._armed = False
+
+    # -- vector clocks ----------------------------------------------------
+
+    def _clock(self, tid: int) -> dict[int, int]:
+        c = self._clocks.get(tid)
+        if c is None:
+            c = self._clocks[tid] = {tid: 0}
+        return c
+
+    def hb_release(self, token) -> None:
+        """Publish the calling thread's clock under ``token``."""
+        tid = threading.get_ident()
+        with self._mu:
+            c = self._clock(tid)
+            c[tid] = c.get(tid, 0) + 1
+            dst = self._sync.setdefault(token, {})
+            for k, v in c.items():
+                if v > dst.get(k, 0):
+                    dst[k] = v
+
+    def hb_acquire(self, token) -> None:
+        """Join the clock published under ``token`` into the caller's."""
+        tid = threading.get_ident()
+        with self._mu:
+            src = self._sync.get(token)
+            if not src:
+                return
+            c = self._clock(tid)
+            for k, v in src.items():
+                if v > c.get(k, 0):
+                    c[k] = v
+
+    # -- field access records --------------------------------------------
+
+    def write(self, owner, field: str) -> None:
+        self._access(owner, field, write=True)
+
+    def read(self, owner, field: str) -> None:
+        self._access(owner, field, write=False)
+
+    def _access(self, owner, field: str, write: bool) -> None:
+        tid = threading.get_ident()
+        key = (owner, field)
+        race = None
+        with self._mu:
+            c = self._clock(tid)
+            prev = self._writes.get(key)
+            if (
+                prev is not None
+                and prev[0] != tid
+                and c.get(prev[0], 0) < prev[1]
+                and key not in self._reported
+            ):
+                self._reported.add(key)
+                race = Race(
+                    f"{owner}.{field}" if not isinstance(owner, str)
+                    else f"{owner}.{field}",
+                    prev[0], prev[2], tid,
+                    "".join(traceback.format_stack(limit=12)),
+                    "write" if write else "read",
+                )
+            if write:
+                c[tid] = c.get(tid, 0) + 1
+                self._writes[key] = (
+                    tid, c[tid],
+                    "".join(traceback.format_stack(limit=12)),
+                )
+        if race is not None:
+            self.races.append(race)
+            if self.strict:
+                raise RuntimeError(f"GRAFT_TSAN: {race.format()}")
+
+    # -- lock profiler hooks ---------------------------------------------
+
+    def _lock_acquired(self, lock: InstrumentedLock, waited: float):
+        self.hb_acquire(("lock", id(lock)))
+        with self._mu:
+            st = self.lock_stats.setdefault(lock.name, {
+                "n": 0, "wait_s": 0.0, "held_s": 0.0,
+                "max_wait_s": 0.0, "max_held_s": 0.0,
+            })
+            st["n"] += 1
+            st["wait_s"] += waited
+            if waited > st["max_wait_s"]:
+                st["max_wait_s"] = waited
+        if waited >= WAIT_EVENT_S and lock._publish_waits:
+            from ..obs import telemetry as obs
+
+            hub = obs.current()
+            if hub is not None:
+                hub.emit("lock_wait", name=lock.name,
+                         wait_s=round(waited, 6))
+
+    def _lock_released(self, lock: InstrumentedLock, held: float):
+        self.hb_release(("lock", id(lock)))
+        with self._mu:
+            st = self.lock_stats.get(lock.name)
+            if st is not None:
+                st["held_s"] += held
+                if held > st["max_held_s"]:
+                    st["max_held_s"] = held
+
+    # -- arm/disarm -------------------------------------------------------
+
+    def __enter__(self):
+        self._arm()
+        return self
+
+    def __exit__(self, *exc):
+        self._disarm()
+        return False
+
+    def _patch(self, obj, name, repl):
+        self._orig.append((obj, name, getattr(obj, name)))
+        setattr(obj, name, repl)
+
+    def _arm(self):
+        if self._armed:
+            return
+        self._armed = True
+        tsan = self
+        import queue as queue_mod
+        from concurrent.futures import Future, ThreadPoolExecutor
+
+        # stdlib hand-off edges ------------------------------------------
+        orig_start = threading.Thread.start
+        orig_join = threading.Thread.join
+
+        def start(t):
+            token = ("thread", id(t))
+            tsan.hb_release(token)
+            orig_run = t.run
+
+            def run():
+                tsan.hb_acquire(token)
+                try:
+                    orig_run()
+                finally:
+                    tsan.hb_release(("thread_end", id(t)))
+
+            t.run = run
+            return orig_start(t)
+
+        def join(t, timeout=None):
+            r = orig_join(t, timeout)
+            if not t.is_alive():
+                tsan.hb_acquire(("thread_end", id(t)))
+            return r
+
+        self._patch(threading.Thread, "start", start)
+        self._patch(threading.Thread, "join", join)
+
+        orig_set = threading.Event.set
+        orig_wait = threading.Event.wait
+
+        def ev_set(ev):
+            tsan.hb_release(("event", id(ev)))
+            return orig_set(ev)
+
+        def ev_wait(ev, timeout=None):
+            r = orig_wait(ev, timeout)
+            if r:
+                tsan.hb_acquire(("event", id(ev)))
+            return r
+
+        self._patch(threading.Event, "set", ev_set)
+        self._patch(threading.Event, "wait", ev_wait)
+
+        orig_submit = ThreadPoolExecutor.submit
+        orig_result = Future.result
+
+        def submit(exe, fn, *args, **kwargs):
+            with tsan._mu:
+                tsan._task_seq += 1
+                n = tsan._task_seq
+            tsan.hb_release(("task", n))
+
+            def wrapped(*a, **k):
+                tsan.hb_acquire(("task", n))
+                try:
+                    return fn(*a, **k)
+                finally:
+                    tsan.hb_release(("task_done", n))
+
+            fut = orig_submit(exe, wrapped, *args, **kwargs)
+            fut._tsan_token = n
+            return fut
+
+        def result(fut, timeout=None):
+            try:
+                return orig_result(fut, timeout)
+            finally:
+                n = getattr(fut, "_tsan_token", None)
+                if n is not None and fut.done():
+                    tsan.hb_acquire(("task_done", n))
+
+        self._patch(ThreadPoolExecutor, "submit", submit)
+        self._patch(Future, "result", result)
+
+        orig_put = queue_mod.Queue.put
+        orig_get = queue_mod.Queue.get
+
+        def put(q, *a, **k):
+            tsan.hb_release(("queue", id(q)))
+            return orig_put(q, *a, **k)
+
+        def get(q, *a, **k):
+            item = orig_get(q, *a, **k)
+            tsan.hb_acquire(("queue", id(q)))
+            return item
+
+        self._patch(queue_mod.Queue, "put", put)
+        self._patch(queue_mod.Queue, "get", get)
+
+        # boundary objects -----------------------------------------------
+        from ..engine import pipeline
+        from ..obs import telemetry as obs_telemetry
+        from ..resilience import elastic
+
+        orig_afw_submit = pipeline.AsyncFetchWindow.submit
+        orig_afw_complete = pipeline.AsyncFetchWindow._complete_one
+
+        def afw_submit(win, arrays, consume):
+            tsan.write("AsyncFetchWindow", "live")
+            return orig_afw_submit(win, arrays, consume)
+
+        def afw_complete(win, run_consume):
+            tsan.write("AsyncFetchWindow", "live")
+            return orig_afw_complete(win, run_consume)
+
+        self._patch(pipeline.AsyncFetchWindow, "submit", afw_submit)
+        self._patch(
+            pipeline.AsyncFetchWindow, "_complete_one", afw_complete
+        )
+
+        orig_pw_init = pipeline.Prewarmer.__init__
+
+        def pw_init(pw, *a, **k):
+            orig_pw_init(pw, *a, **k)
+            pw._lock = InstrumentedLock(
+                tsan, "pipeline.Prewarmer._lock"
+            )
+
+        self._patch(pipeline.Prewarmer, "__init__", pw_init)
+
+        orig_wd_init = elastic.Watchdog.__init__
+        orig_wd_fire = elastic.Watchdog._fire
+
+        def wd_init(wd, *a, **k):
+            orig_wd_init(wd, *a, **k)
+            # Condition binds acquire/release at construction, so the
+            # instrumented lock must go in via a NEW Condition (the
+            # watchdog thread starts lazily; nothing waits yet)
+            wd._cv = threading.Condition(
+                InstrumentedLock(tsan, "elastic.Watchdog._cv")
+            )
+
+        def wd_fire(wd, ctx):
+            tsan.write("Watchdog", "fired")
+            return orig_wd_fire(wd, ctx)
+
+        self._patch(elastic.Watchdog, "__init__", wd_init)
+        self._patch(elastic.Watchdog, "_fire", wd_fire)
+        # a watchdog installed BEFORE arming (check.py builds it before
+        # entering the tsan context) — its deadline thread starts
+        # lazily at the first arm(), which is always inside the
+        # context, so nothing waits on the old condition yet
+        wd = getattr(elastic, "_WATCHDOG", None)
+        if wd is not None and getattr(wd, "_thread", None) is None:
+            wd._cv = threading.Condition(
+                InstrumentedLock(tsan, "elastic.Watchdog._cv")
+            )
+
+        def hub_locks(hub):
+            hub._lock = InstrumentedLock(
+                tsan, "telemetry.TelemetryHub._lock",
+                publish_waits=False,
+            )
+            hub._io_lock = InstrumentedLock(
+                tsan, "telemetry.TelemetryHub._io_lock",
+                publish_waits=False,
+            )
+
+        orig_hub_init = obs_telemetry.TelemetryHub.__init__
+
+        def hub_init(hub, *a, **k):
+            orig_hub_init(hub, *a, **k)
+            hub_locks(hub)
+
+        self._patch(obs_telemetry.TelemetryHub, "__init__", hub_init)
+        # a hub installed BEFORE arming (check.py creates it early)
+        # gets its locks swapped in place — only the main thread is
+        # live at arm time, so nothing can hold them mid-swap
+        hub = obs_telemetry.current()
+        if hub is not None:
+            hub_locks(hub)
+
+    def _disarm(self):
+        if not self._armed:
+            return
+        self._armed = False
+        for obj, name, orig in reversed(self._orig):
+            setattr(obj, name, orig)
+        self._orig.clear()
+        self._publish_lock_stats()
+
+    def _publish_lock_stats(self):
+        with contextlib.suppress(Exception):
+            from ..obs import telemetry as obs
+
+            hub = obs.current()
+            if hub is None:
+                return
+            for name, st in sorted(self.lock_stats.items()):
+                hub.emit(
+                    "lock_held", name=name, n=st["n"],
+                    wait_s=round(st["wait_s"], 6),
+                    held_s=round(st["held_s"], 6),
+                    max_wait_s=round(st["max_wait_s"], 6),
+                    max_held_s=round(st["max_held_s"], 6),
+                )
+
+    # -- reporting --------------------------------------------------------
+
+    @property
+    def ok(self) -> bool:
+        return not self.races
+
+    def report(self) -> dict:
+        return dict(
+            ok=self.ok,
+            races=[r.field for r in self.races],
+            locks={k: dict(v) for k, v in self.lock_stats.items()},
+        )
+
+    def print_report(self, out) -> None:
+        n = sum(st["n"] for st in self.lock_stats.values())
+        print(
+            f"TSan: {len(self.lock_stats)} instrumented locks, "
+            f"{n} acquires profiled, {len(self.races)} race(s).",
+            file=out,
+        )
+        for name, st in sorted(self.lock_stats.items()):
+            print(
+                f"TSan: lock {name}: n={st['n']} "
+                f"wait={st['wait_s']:.4f}s (max {st['max_wait_s']:.4f}s) "
+                f"held={st['held_s']:.4f}s (max {st['max_held_s']:.4f}s)",
+                file=out,
+            )
+        for r in self.races:
+            print(f"TSan: RACE — {r.format()}", file=out)
+        print("TSan: OK" if self.ok else "TSan: FAIL", file=out)

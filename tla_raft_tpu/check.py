@@ -429,6 +429,7 @@ def _run_check_impl(
     out = out if out is not None else _Silent()
     t0 = time.monotonic()
     sanitizer = None
+    tsan = None
     chk = None  # the engine instance (None on the oracle backend)
     if backend == "oracle":
         from .oracle import OracleChecker
@@ -467,6 +468,24 @@ def _run_check_impl(
                 f"Sanitizer: armed (warmup {sanitizer.warmup_levels} "
                 f"levels, {'strict' if sanitizer.strict else 'counting'} "
                 "transfer guard)",
+                file=out,
+            )
+
+        if os.environ.get("GRAFT_TSAN") == "1":
+            # graftsync layer 2 (docs/ANALYSIS.md): happens-before
+            # sanitizer + lock contention profiler over the known
+            # thread boundaries; composes with GRAFT_SANITIZE.
+            # GRAFT_TSAN_STRICT=1 raises at the racing access instead
+            # of reporting at exit (exit code 3 either way).
+            from .analysis.tsan import TSan
+
+            tsan = TSan(
+                strict=os.environ.get("GRAFT_TSAN_STRICT") == "1"
+            )
+            print(
+                "TSan: armed (happens-before sanitizer, "
+                f"{'strict' if tsan.strict else 'report-at-exit'}; "
+                "lock profiler on)",
                 file=out,
             )
 
@@ -567,6 +586,7 @@ def _run_check_impl(
         sanctx = sanitizer if sanitizer is not None else (
             contextlib.nullcontext()
         )
+        tsanctx = tsan if tsan is not None else contextlib.nullcontext()
         if mesh:
             if dev_bytes:
                 print(
@@ -606,7 +626,7 @@ def _run_check_impl(
                     file=out,
                 )
             try:
-                with sanctx:
+                with sanctx, tsanctx:
                     res = chk.run(
                         max_depth=max_depth,
                         checkpoint_dir=checkpoint_dir,
@@ -636,7 +656,7 @@ def _run_check_impl(
                         file=out,
                     )
         else:
-            with sanctx:
+            with sanctx, tsanctx:
                 chk = JaxChecker(
                     cfg, chunk=chunk, progress=progress,
                     host_store=host_store, canon=canon,
@@ -689,6 +709,7 @@ def _run_check_impl(
     summary["_res"] = res
     summary["_chk"] = chk
     summary["_sanitizer"] = sanitizer
+    summary["_tsan"] = tsan
     summary["_hub"] = hub
     return summary
 
@@ -1070,6 +1091,7 @@ def main(argv=None) -> int:
     res = summary["_res"]
     chk = summary["_chk"]
     sanitizer = summary["_sanitizer"]
+    tsan = summary.get("_tsan")
     hub = summary.get("_hub")
 
     if pline is not None:
@@ -1085,6 +1107,8 @@ def main(argv=None) -> int:
         )
     if sanitizer is not None:
         sanitizer.print_report(out)
+    if tsan is not None:
+        tsan.print_report(out)
     if res.ok:
         print("Model checking completed. No error has been found.", file=out)
     else:
@@ -1118,9 +1142,13 @@ def main(argv=None) -> int:
         print(json.dumps(summarize(res, chk, dt, hub=hub)), file=out)
     if logf:
         logf.close()
-    if res.ok and sanitizer is not None and not sanitizer.ok:
-        # sanitizer findings on an otherwise-clean run: distinct exit
-        # code so CI can tell "model violation" from "runtime hygiene"
+    if res.ok and (
+        (sanitizer is not None and not sanitizer.ok)
+        or (tsan is not None and not tsan.ok)
+    ):
+        # sanitizer/tsan findings on an otherwise-clean run: distinct
+        # exit code so CI can tell "model violation" from "runtime
+        # hygiene"
         return 3
     return 0 if res.ok else 1
 

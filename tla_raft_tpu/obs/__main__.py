@@ -60,7 +60,9 @@ def summarize_events(events: list[dict]) -> dict:
         # renderers blank them out rather than erroring
         tier_demotions=0, tier_probes=0, tier_wait_s=0.0,
         programs_profiled=0, pre_oom_forecasts=0,
+        lock_waits=0, lock_wait_s=0.0,
     )
+    locks: dict[str, dict] = {}
     for doc in events:
         try:
             t = float(doc.get("t", 0.0))
@@ -106,6 +108,17 @@ def summarize_events(events: list[dict]) -> dict:
             totals["tier_wait_s"] += float(doc.get("s") or 0.0)
         elif k == "program_profile":
             totals["programs_profiled"] += 1
+        elif k == "lock_held":
+            locks[str(doc.get("name"))] = dict(
+                n=int(doc.get("n") or 0),
+                wait_s=float(doc.get("wait_s") or 0.0),
+                held_s=float(doc.get("held_s") or 0.0),
+                max_wait_s=float(doc.get("max_wait_s") or 0.0),
+                max_held_s=float(doc.get("max_held_s") or 0.0),
+            )
+        elif k == "lock_wait":
+            totals["lock_waits"] += 1
+            totals["lock_wait_s"] += float(doc.get("wait_s") or 0.0)
         elif k == "pre_oom_forecast":
             totals["pre_oom_forecasts"] += 1
         elif k == "level_commit":
@@ -124,9 +137,12 @@ def summarize_events(events: list[dict]) -> dict:
                        grows=0, redos=0, checkpoint_s=0.0,
                        tier_wait_s=0.0)
     for k in ("fetch_wait_s", "compile_s", "checkpoint_s", "wall_s",
-              "tier_wait_s"):
+              "tier_wait_s", "lock_wait_s"):
         totals[k] = round(totals[k], 4)
-    return dict(levels=levels, totals=totals)
+    rep = dict(levels=levels, totals=totals)
+    if locks:
+        rep["locks"] = locks
+    return rep
 
 
 def _print_table(tag: str, rep: dict, out) -> None:
@@ -176,8 +192,28 @@ def _print_table(tag: str, rep: dict, out) -> None:
         extras.append(
             f"PRE-OOM forecasts: {t['pre_oom_forecasts']}"
         )
+    if t.get("lock_waits"):
+        extras.append(
+            f"lock contention: {t['lock_waits']} slow acquire(s) "
+            f"({t.get('lock_wait_s', 0.0):.3f}s blocked)"
+        )
     if extras:
         print("        " + "; ".join(extras), file=out)
+    # GRAFT_TSAN lock profile: one row per instrumented lock, worst
+    # offenders (by total hold) first
+    if rep.get("locks"):
+        print(f"{'lock':<36} {'acq':>7} {'wait_s':>9} {'max_w':>8} "
+              f"{'held_s':>9} {'max_h':>8}", file=out)
+        rows = sorted(
+            rep["locks"].items(), key=lambda kv: -kv[1]["held_s"]
+        )
+        for name, st in rows:
+            print(
+                f"{name:<36} {st['n']:>7} {st['wait_s']:>9.4f} "
+                f"{st['max_wait_s']:>8.4f} {st['held_s']:>9.4f} "
+                f"{st['max_held_s']:>8.4f}",
+                file=out,
+            )
 
 
 def _cmd_report(args) -> int:

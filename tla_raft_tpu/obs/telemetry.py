@@ -62,6 +62,13 @@ Event taxonomy (docs/OBSERVABILITY.md):
                   (predictive, vs. the reactive overflow-redo)
 ``profile_begin``/``profile_end``  one ``--profile N`` jax-profiler
                   capture window (``dir`` holds the device trace)
+``lock_held``     one instrumented lock's whole-run aggregate
+                  (``GRAFT_TSAN=1``, analysis/tsan.py): ``name``,
+                  ``n`` acquires, ``wait_s``/``held_s`` totals,
+                  ``max_wait_s``/``max_held_s``
+``lock_wait``     one acquire that blocked past the contention
+                  threshold: ``name``, ``wait_s`` (the trace's
+                  contention track)
 ================  ======================================================
 
 Rotation: the stream is capped at ``TLA_RAFT_TELEMETRY_BYTES``
@@ -383,6 +390,12 @@ class TelemetryHub:
         self.audit_levels = 0
         self.audit_rows = 0
         self.audit_problems = 0
+        # graftsync lock profiler (analysis/tsan.py): per-lock
+        # hold/wait aggregates published at disarm + threshold
+        # contention events published at the blocking acquire
+        self.locks: dict[str, dict] = {}
+        self.lock_waits = 0
+        self.lock_wait_s = 0.0
         self.retired = 0
         self.exchange_bytes = 0
         self.exchange_raw_bytes = 0
@@ -555,6 +568,17 @@ class TelemetryHub:
         elif ev == "superstep_commit":
             self.supersteps += 1
             self.superstep_levels += int(doc.get("levels") or 0)
+        elif ev == "lock_held":
+            self.locks[str(doc.get("name"))] = dict(
+                n=int(doc.get("n") or 0),
+                wait_s=float(doc.get("wait_s") or 0.0),
+                held_s=float(doc.get("held_s") or 0.0),
+                max_wait_s=float(doc.get("max_wait_s") or 0.0),
+                max_held_s=float(doc.get("max_held_s") or 0.0),
+            )
+        elif ev == "lock_wait":
+            self.lock_waits += 1
+            self.lock_wait_s += float(doc.get("wait_s") or 0.0)
         elif ev == "watchdog_arm":
             self.watchdog_armed += 1
         elif ev == "watchdog_trip":
@@ -652,6 +676,11 @@ class TelemetryHub:
             if self.programs_profiled:
                 out["programs_profiled"] = self.programs_profiled
                 out["program_temp_bytes"] = dict(self.program_temp)
+            if self.locks:
+                out["locks"] = {k: dict(v) for k, v in self.locks.items()}
+            if self.lock_waits:
+                out["lock_waits"] = self.lock_waits
+                out["lock_wait_s"] = round(self.lock_wait_s, 6)
             if self.rotations:
                 out["rotations"] = self.rotations
             if self.profile_windows:

@@ -53,6 +53,7 @@ TRACKS = {
     8: "watchdog/audit",
     9: "tiered store",
     10: "device cost",
+    11: "lock contention",
 }
 
 
@@ -120,6 +121,12 @@ def to_chrome_trace(events: list[dict]) -> dict:
             s = float(doc.get("s") or 0.0)
             ev("X", 4, "fetch", t - s, dur=s,
                args=dict(bytes=doc.get("b")))
+        elif kind == "lock_wait":
+            # GRAFT_TSAN contention: the slice spans the blocked
+            # acquire (t is the acquisition instant)
+            s = float(doc.get("wait_s") or 0.0)
+            ev("X", 11, f"wait {doc.get('name')}", t - s, dur=s,
+               args=dict(name=doc.get("name")))
         elif kind == "checkpoint":
             s = float(doc.get("s") or 0.0)
             ev("X", 5, f"commit {doc.get('kind')}", t - s, dur=s,
