@@ -39,7 +39,9 @@ sort-path A/B), BENCH_PIPELINE (0 = serial-chain A/B) /
 BENCH_PIPELINE_WINDOW (in-flight fetch groups, default 2), BENCH_MXU
 (0 = legacy per-lane expand A/B), BENCH_TIERED (1 = cap the hot visited
 slab at BENCH_TIERED_BYTES, forcing generation demotions to host/disk —
-the out-of-core tiered-store A/B), BENCH_MEGAKERNEL (0 = staged
+the out-of-core tiered-store A/B), BENCH_SIEVE (0 = spill sieve off, so
+a tiered run stands its superstep down to span 1 — the sieve A/B; only
+meaningful with BENCH_TIERED=1), BENCH_MEGAKERNEL (0 = staged
 program-chain A/B vs the fused whole-level program; dispatches/level
 land in the record either way), BENCH_SUPERSTEP (0 = per-level fused
 A/B vs the multi-level resident superstep driver; levels_per_dispatch
@@ -652,6 +654,13 @@ def main():
                                      str(1 << 17))))
             if int(os.environ.get("BENCH_TIERED", "0")) else 0
         )
+        # BENCH_SIEVE=0 disables the device-resident spill sieve
+        # (ops/sieve.py), reverting a tiered run to PR 12's span-1
+        # stand-down — the A/B lever for the sieve's dispatch-
+        # amortization recovery (docs/PERF.md "Spill sieve +
+        # compaction").  Counts are bit-identical either way; the
+        # interesting delta is levels_per_dispatch under spill.
+        use_sieve = bool(int(os.environ.get("BENCH_SIEVE", "1")))
         # BENCH_AUDIT=1 arms the end-to-end integrity audit at
         # BENCH_AUDIT_N rows/level (default 64) — the A/B lever for the
         # audit-mode overhead record (docs/ROBUSTNESS.md; target < 5%
@@ -718,6 +727,7 @@ def main():
                     use_mxu=use_mxu, megakernel=use_mega, audit=audit_n,
                     superstep=use_superstep,
                     store_bytes=tier_bytes or None,
+                    sieve=use_sieve,
                 )
                 res = chk1.run(max_depth=max_depth)
             finally:
@@ -843,6 +853,12 @@ def main():
         # the tiered-store lever (0 = hot-only): budget + the demotion
         # and per-tier probe accounting when it actually spilled
         "tiered_bytes": tier_bytes if not mesh_n else 0,
+        # the sieve lever's EFFECTIVE state (off on the mesh arms and
+        # whenever the engine ran without tiering)
+        "sieve": (
+            bool(getattr(chk1, "sieve_enabled", False)) if not mesh_n
+            else False
+        ),
     }
     if not mesh_n and tier_bytes and getattr(chk1, "tiered", None):
         ts = chk1.tiered.stats
@@ -851,7 +867,20 @@ def main():
             generations=len(chk1.tiered.gens),
             probe_wait_s=round(ts["probe_wait_s"], 6),
             cold_load_s=round(ts["cold_load_s"], 6),
+            compact_s=round(ts.get("compact_s", 0.0), 6),
         )
+        # superstep sieve accounting: how often an in-kernel sieve hit
+        # stopped a window early (each stop = one per-level replay)
+        ss = getattr(chk1, "_ss_stats", None)
+        if ss:
+            out["superstep_stats"] = {
+                k: int(v) for k, v in sorted(ss.items())
+            }
+    if not mesh_n and getattr(chk1, "_fpager", None) is not None:
+        # spilled-frontier paging (engine/bfs.py FrontierPager): disk
+        # traffic of levels whose working set outgrew TLA_RAFT_DEV_BYTES
+        fp = chk1._fpager.stats
+        out["fseg"] = dict(fp, fseg_load_s=round(fp["fseg_load_s"], 6))
     if not mesh_n:
         # per-level wall clock + program dispatches (the fused-vs-
         # staged A/B's secondary metric: launches/level is exactly
@@ -931,7 +960,8 @@ def main():
         for k in ("mesh", "mesh_deep", "peak_dev_rows", "exchange",
                   "telemetry", "level_seconds", "dispatches_per_level",
                   "steady_max_dispatches_per_level",
-                  "levels_per_dispatch", "tiered_bytes", "tiered"):
+                  "levels_per_dispatch", "tiered_bytes", "tiered",
+                  "sieve", "superstep_stats", "fseg"):
             if k in out:
                 record[k] = out[k]
         tmp = bench_out + ".tmp"

@@ -221,6 +221,9 @@ def test_elastic_plain_mesh_both_directions(tmp_path, golden_s2):
         _assert_golden(res, golden_s2)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 15): legacy-manifest migration
+# compat row; elastic resume itself stays fast via
+# test_elastic_deep_kill_resume_4_to_2_via_cli
 def test_legacy_run_fp_migrates_on_resume(tmp_path, golden_s2):
     """Pre-elastic mesh checkpoints pinned the device count into the
     manifest run fingerprint; resuming one must MIGRATE the manifest
@@ -280,6 +283,9 @@ def test_owner_rebalance_math():
 
 # -- pillar 2: watchdog + device loss --------------------------------------
 
+@pytest.mark.slow  # tier-1 budget (PR 15): the CLI hang->exit75->
+# resume drill; the arm/soft/hard trip machinery stays fast via
+# test_watchdog_mechanics_inprocess
 def test_watchdog_hang_becomes_exit75_then_resume(tmp_path, golden_s2):
     """An injected hung dispatch is converted by the watchdog into a
     resumable exit 75 (cooperative first, hard exit if wedged); the

@@ -221,6 +221,9 @@ def test_engine_resume_through_slab_dump(tmp_path):
 
 # -- mesh: hash-slab owner shards + hash sieve ----------------------------
 
+@pytest.mark.slow  # tier-1 budget (PR 15): the deep-mode hash-vs-
+# sorted parity row (test_mesh_deep_hash_sieve_matches_sorted_sieve)
+# stays fast and covers mesh hash-slab parity incl. resume
 def test_mesh_a2a_hash_shards_match_sorted(tmp_path):
     """Plain all_to_all mesh: hash-slab owner shards vs sorted shards,
     identical counts and coverage on the S2 fixpoint."""

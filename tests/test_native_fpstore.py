@@ -57,6 +57,36 @@ def test_spill_to_runs_and_compact(tmp_path, built):
     st.close()
 
 
+def test_per_run_blooms_skip_searches_without_false_negatives(
+        tmp_path, built):
+    """Every spilled run carries an in-memory blocked bloom
+    (fpstore.cpp, ops/sieve.py's C++ twin) tested before the run's
+    binary search: fresh keys mostly skip the search (bloom_skips),
+    members NEVER do (no false negatives), and compaction rebuilds the
+    merged run's filter with membership intact."""
+    st = HostFPStore(str(tmp_path / "fp"), mem_budget_entries=128)
+    rng = np.random.default_rng(3)
+    keys = np.unique(rng.integers(0, 1 << 62, size=1_000, dtype=np.uint64))
+    st.insert(keys)
+    assert st.num_runs >= 1
+    assert st.contains(keys).all()  # bloom hit -> exact search -> hit
+    skips0 = st.bloom_skips
+    fresh = rng.integers(1 << 62, 1 << 63, size=5_000, dtype=np.uint64)
+    assert not st.contains(fresh).any()
+    # ~8 bits/key blooms reject the overwhelming share of fresh keys
+    # before any per-run binary search (one skip per run per miss)
+    skipped = st.bloom_skips - skips0
+    assert skipped > 0.9 * len(fresh) * st.num_runs, (
+        skipped, len(fresh), st.num_runs,
+    )
+    st.compact()
+    assert st.num_runs == 1
+    assert st.contains(keys).all()  # merged run's rebuilt bloom: exact
+    assert not st.contains(fresh).any()
+    assert st.bloom_skips > skipped
+    st.close()
+
+
 def test_engine_with_host_store_matches_oracle(tmp_path, built):
     from tla_raft_tpu.config import RaftConfig
     from tla_raft_tpu.engine import JaxChecker

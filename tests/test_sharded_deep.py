@@ -69,6 +69,9 @@ def _assert_deep_matches(chk, got, eng, eng_fps):
         assert s.contains(own).all(), f"owner {o} is missing engine fps"
 
 
+@pytest.mark.slow  # tier-1 budget (PR 15): deep-vs-engine parity
+# stays fast via test_deep_matches_uncompressed_exchange (4-dev) +
+# test_deep_multisegment_and_oracle_parity; this is the 8-dev scale-up
 def test_deep_parity_8dev_s3_vs_engine(tmp_path):
     """Tier-1 gate: 8-device sieve+compress deep sweep == single-device
     engine on an S=3 config, full fixpoint (depth >= 8), counts AND

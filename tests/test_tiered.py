@@ -164,8 +164,13 @@ def test_store_demote_probe_sieve_and_cold(tmp_path):
     st.demote(g2, depth=5)
     assert len(st.gens) == 2
     assert st.stats["demotions"] == 2
-    # both runs committed through the atomic writer
-    assert len(glob.glob(os.path.join(str(tmp_path), "gen_*.npz"))) == 2
+    # both runs committed through the atomic writer (the bloom
+    # side-cars land beside them as gen_*.sieve.npz)
+    paths = glob.glob(os.path.join(str(tmp_path), "gen_*.npz"))
+    runs = [p for p in paths if not p.endswith(".sieve.npz")]
+    cars = [p for p in paths if p.endswith(".sieve.npz")]
+    assert len(runs) == 2
+    assert len(cars) == 2
     # the 64-byte warm budget evicted the runs to cold (disk-only)
     assert any(g.cold for g in st.gens)
     probe = np.asarray([150, 999, 1050, 42], np.uint64)

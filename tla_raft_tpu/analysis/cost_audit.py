@@ -73,6 +73,7 @@ def compiled_registry():
     from ..engine.bfs import JaxChecker
     from ..models.raft import init_batch
     from ..ops import hashstore
+    from ..ops import sieve as sieve_mod
     from ..ops.successor import get_kernel
     from ..store import tiered as tiered_mod
 
@@ -98,7 +99,8 @@ def compiled_registry():
         prog = megakernel_mod.build_level_program(eng, donate=False)
         return prog.lower(
             fr, jax.ShapeDtypeStruct((hashstore.MIN_CAP,), jnp.uint64),
-            jax.ShapeDtypeStruct((), jnp.int64), cap_out=64,
+            jax.ShapeDtypeStruct((), jnp.int64),
+            jax.ShapeDtypeStruct((1,), jnp.uint64), cap_out=64,
         ).compile()
 
     def _sstep():
@@ -112,7 +114,8 @@ def compiled_registry():
         s_i64 = jax.ShapeDtypeStruct((), jnp.int64)
         return prog.lower(
             fr, jax.ShapeDtypeStruct((hashstore.MIN_CAP,), jnp.uint64),
-            s_i64, s_i64, cap_f=64, ring=128,
+            s_i64, s_i64, jax.ShapeDtypeStruct((1,), jnp.uint64),
+            cap_f=64, ring=128,
         ).compile()
 
     def _tiered():
@@ -140,6 +143,10 @@ def compiled_registry():
         "engine.megakernel_level": _mega,
         "engine.superstep": _sstep,
         "store.tiered_compact": _tiered,
+        "ops.sieve_probe":
+            lambda: _compile(
+                sieve_mod.probe_impl, jnp.zeros((64,), jnp.uint64), fps
+            ),
     }
 
 

@@ -72,6 +72,7 @@ GL010_KERNELS = (
     "engine.megakernel_level",
     "engine.superstep",
     "store.tiered_compact",
+    "ops.sieve_probe",
 )
 
 
@@ -110,6 +111,7 @@ def kernel_registry():
     from ..store import tiered as tiered_mod
     from ..models.raft import init_batch
     from ..ops import hashstore
+    from ..ops import sieve as sieve_mod
     from ..ops.successor import get_kernel
     from ..parallel.exchange import pack_fp_deltas
 
@@ -178,6 +180,12 @@ def kernel_registry():
         # level-tail correction can never grow a gather storm
         "store.tiered_compact":
             lambda: tiered_mod.ledger_trace(cfg),
+        # the device spill-sieve probe (ops/sieve.py): the in-kernel
+        # filter over spilled generations — the budget pins ONE
+        # data-indexed gather per probe (the blocked-bloom word fetch);
+        # everything else is lane-local bit algebra
+        "ops.sieve_probe":
+            lambda: sieve_mod.ledger_trace(cfg),
     }
 
 

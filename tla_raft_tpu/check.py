@@ -249,6 +249,23 @@ def summarize(res, chk=None, seconds: float | None = None,
             probe_wait_s=round(tiered.stats["probe_wait_s"], 6),
             cold_load_s=round(tiered.stats["cold_load_s"], 6),
         )
+        out["sieve"] = bool(getattr(chk, "sieve_enabled", False))
+        # superstep window accounting (supersteps/levels/stop causes):
+        # under spill this is the span-survival evidence — sieve-clean
+        # windows keep committing levels, sieve_stops count the exact
+        # per-level corrections (ops/sieve.py)
+        ss = getattr(chk, "_ss_stats", None)
+        if ss and ss.get("supersteps"):
+            out["superstep_stats"] = {
+                k: int(v) for k, v in sorted(ss.items())
+            }
+        # spilled-frontier paging (store/tiered.py FrontierPager)
+        fpager = getattr(chk, "_fpager", None)
+        if fpager is not None and fpager.stats["fseg_spills"]:
+            out["fseg"] = dict(
+                fpager.stats,
+                fseg_load_s=round(fpager.stats["fseg_load_s"], 6),
+            )
     # per-owner straggler/skew metrics (mesh runs); kept at top level
     # for compatibility AND folded into the telemetry block below
     skew = getattr(chk, "skew", None)

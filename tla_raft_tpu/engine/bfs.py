@@ -58,6 +58,7 @@ import functools
 import os
 import sys
 import time
+import weakref
 import zipfile
 from typing import Callable, NamedTuple
 
@@ -71,6 +72,7 @@ from ..obs import telemetry as graft_obs
 from ..config import RaftConfig
 from ..models.raft import RaftState, init_batch, to_oracle
 from ..ops import hashstore
+from ..ops import sieve as graft_sieve
 from ..ops.successor import SuccessorKernel, get_kernel
 from ..store import tiered as graft_tiered
 from . import megakernel as graft_megakernel
@@ -138,16 +140,50 @@ class _HostSeg:
     destination segments demote to host RAM under a device-byte budget
     (TLA_RAFT_DEV_BYTES) and page back in on demand — the expand and
     materialize walks both consume segments in ascending payload order,
-    so residency is a moving window, not a working set."""
+    so residency is a moving window, not a working set.
 
-    __slots__ = ("fields",)
+    Below host RAM sits the WARM tier: a segment past the host budget
+    (TLA_RAFT_FSEG_BYTES) spills its field dict to disk through the
+    tiered store's FrontierPager (kind="fseg" via the atomic writer)
+    and reloads lazily the first time ``fields`` is touched again —
+    the same moving-window residency, one tier further down."""
+
+    __slots__ = ("_fields", "_rows", "pager", "token", "__weakref__")
 
     def __init__(self, fields: dict):
-        self.fields = fields
+        self._fields = fields
+        self._rows = fields["voted_for"].shape[0]
+        self.pager = None
+        self.token = None
+
+    @property
+    def fields(self) -> dict:
+        if self._fields is None:
+            self._fields = self.pager.load(self.token)
+        return self._fields
 
     @property
     def rows(self) -> int:
-        return self.fields["voted_for"].shape[0]
+        return self._rows
+
+    @property
+    def resident_bytes(self) -> int:
+        """Host-RAM footprint (0 while spilled to the warm tier)."""
+        if self._fields is None:
+            return 0
+        return sum(
+            int(np.prod(v.shape)) * v.dtype.itemsize
+            for v in self._fields.values()
+        )
+
+    def spill(self, pager, depth: int = -1) -> None:
+        """Commit the field dict to the warm tier and drop the RAM copy
+        (idempotent re-spill: a reloaded segment already has a token —
+        its artifact is still on disk, so dropping the copy is free)."""
+        if self.token is None:
+            self.token = pager.spill(self._fields, depth=depth)
+            self.pager = pager
+        self._fields = None
 
 
 def _seg_rows(seg) -> int:
@@ -473,6 +509,7 @@ class JaxChecker:
         watchdog=None,
         store_bytes: int | None = None,
         warm_bytes: int | None = None,
+        sieve: bool | None = None,
     ):
         # canon="late": expand computes guards only; the compacted
         # candidates are materialized and fingerprinted with the full-state
@@ -560,6 +597,19 @@ class JaxChecker:
         self.store_bytes = int(store_bytes)
         self.warm_bytes = warm_bytes  # None = TLA_RAFT_WARM_BYTES
         self.tiered = None  # TieredVisitedStore, built in run()/resume
+        # device-resident spill sieve (ops/sieve.py): a blocked bloom
+        # over every demoted fingerprint, probed INSIDE the fused
+        # megakernel/superstep body — a level with zero sieve hits
+        # provably has no spilled revisits and commits in-window, which
+        # restores span-N supersteps under spill (the PR 12 stand-down
+        # becomes the sieve-off fallback).  Default ON wherever tiering
+        # is; TLA_RAFT_SIEVE=0 / sieve=False reverts to span-1.
+        if sieve is None:
+            sieve = os.environ.get("TLA_RAFT_SIEVE", "1") != "0"
+        self.sieve_enabled = bool(sieve)
+        self._dev_sieve = None      # device u64[M] copy of the filter
+        self._dev_sieve_ver = -1    # host filter version it mirrors
+        self._dev_sieve_empty = None  # the 1-word all-miss sentinel
         # device-byte budget for frontier segments (0 = paging off): when
         # one level's parent+child segments would exceed it, sealed child
         # segments demote to host RAM and page back in on demand — the
@@ -575,6 +625,22 @@ class JaxChecker:
         # so set the budget several segments below physical HBM
         # (run_sweep.sh's 11 GB of 16 GB leaves ~45 segments' worth)
         self.dev_budget = int(float(os.environ.get("TLA_RAFT_DEV_BYTES", "0")))
+        # spilled frontiers (the tier BELOW _HostSeg's host RAM): a
+        # FrontierPager built in run() when a spill directory exists;
+        # host segments past TLA_RAFT_FSEG_BYTES commit to the warm
+        # tier (kind="fseg") and reload on demand.  fseg_rows is the
+        # uniform segment size the streamed megakernel path cuts
+        # oversized parents into (default SEG_ROWS; override for tests)
+        self._fpager = None
+        self.fseg_host_bytes = graft_tiered.fseg_bytes_from_env()
+        fsr = int(os.environ.get("TLA_RAFT_FSEG_ROWS", "0") or 0)
+        self.fseg_rows = max(
+            -(-fsr // chunk) * chunk if fsr else SEG_ROWS, chunk
+        )
+        self._fseg_live = []    # weakrefs of admitted host segments
+        self._fseg_retire = []  # consumed segments' tokens (retired at
+        #                         the next level top — never mid-level,
+        #                         so a degrade-redo still has parents)
         # async intra-level pipeline (engine/pipeline.py): overlap the
         # device expand dispatch, the device->host group fetches and the
         # host-side tail under a bounded in-flight window.  Default ON;
@@ -599,7 +665,8 @@ class JaxChecker:
             prewarm = bool(int(env_pw)) if env_pw else _is_tunneled()
         self.prewarm = bool(prewarm)
         self._prewarmer = None  # built lazily at first plan submit
-        self.paged_out = 0  # sealed child segments demoted to host RAM
+        self.paged_out = 0   # sealed child segments demoted to host RAM
+        self.paged_disk = 0  # host segments spilled on to the warm tier
         if host_store is not None and chunk > SEG_ROWS:
             # the segment walkers assume chunks never straddle segment
             # boundaries (chunk is pow2 and <= SEG_ROWS => SEG_ROWS % chunk
@@ -1171,13 +1238,15 @@ class JaxChecker:
             # device-cost observatory: harvest the fused program's XLA
             # cost/memory ledger once per shape (compile-time only —
             # the lower+compile lands in the cache this call then hits)
+            sieve_dev = self._sieve_operand()
             graft_devprof.profile_program(
                 "megakernel.level", self._mega_prog,
-                frontier, self.hstore.slab, n_f_dev,
+                frontier, self.hstore.slab, n_f_dev, sieve_dev,
                 statics=dict(cap_out=cap_out),
             )
             outs = self._mega_prog(
-                frontier, self.hstore.slab, n_f_dev, cap_out=cap_out
+                frontier, self.hstore.slab, n_f_dev, sieve_dev,
+                cap_out=cap_out,
             )
             if self._mega_donate:
                 (new_frontier, slab2, ctrl_d, mult_d, fps_d, pidx_d,
@@ -1270,6 +1339,7 @@ class JaxChecker:
             abort_at=int(ctrl[mk.CTRL_ABORT]),
             bad_idx=int(ctrl[mk.CTRL_BAD]),
             slab_live=int(ctrl[mk.CTRL_SLAB_LIVE]),
+            tier_hits=int(ctrl[mk.CTRL_TIER_HITS]),
             level_mult=np.asarray(mult_np, np.int64),
             new_frontier=new_frontier,
             parent=frontier,
@@ -1279,19 +1349,284 @@ class JaxChecker:
             cap_out=cap_out,
         )
 
+    def _mega_segs_ok(self, frontier, n_f) -> bool:
+        """Is this level eligible for the SEGMENT-STREAMED fused path?
+
+        The single-frontier fused program needs parent + children
+        resident at once; a level past the paging budget streams the
+        parent through the same program one uniform segment at a time
+        instead (``_expand_level_mega_segs``), so a frontier that
+        outgrows HBM still runs fused.  Eligible when the frontier is
+        already a segment list, or a single device frontier whose
+        level working set (parent + like-sized children) would bust
+        TLA_RAFT_DEV_BYTES.  The audit path re-expands sampled rows
+        from live parents and keeps the unsegmented routes."""
+        if not self.megakernel or not self.use_hashstore:
+            return False
+        if self.host_store is not None or self.audit:
+            return False
+        # per-segment dispatch bound: same 16*G grouping threshold the
+        # whole-level gate applies, against ONE segment's chunk count
+        if -(-self.fseg_rows // self.chunk) > 16 * self.G:
+            return False
+        if isinstance(frontier, list):
+            return True
+        if not self.dev_budget or n_f <= self.fseg_rows:
+            return False
+        return 2 * self._tree_bytes(frontier) > self.dev_budget
+
+    def _cut_frontier(self, frontier, n_f: int, depth: int) -> list:
+        """Cut one device frontier into uniform host segments of
+        ``fseg_rows`` (the streamed path's input form).  One D2H fetch;
+        the device copy is released so the level's HBM peak is one
+        segment + its children, not the whole parent."""
+        L = self.fseg_rows
+        host = {
+            f: np.asarray(jax.device_get(getattr(frontier, f)))
+            for f in Frontier._fields
+        }
+        del frontier
+        n_seg = -(-max(n_f, 1) // L)
+        segs = []
+        for j in range(n_seg):
+            flds = {}
+            for f, v in host.items():
+                part = v[j * L:(j + 1) * L]
+                if part.shape[0] < L:
+                    part = np.concatenate([
+                        part,
+                        np.zeros((L - part.shape[0],) + part.shape[1:],
+                                 part.dtype),
+                    ])
+                flds[f] = part
+            hs = _HostSeg(flds)
+            self._fseg_admit(hs, depth)
+            segs.append(hs)
+        return segs
+
+    def _expand_level_mega_segs(self, segs, n_f, max_depth, level_sizes,
+                                depth):
+        """Spilled-frontier streaming: one fused level, one PARENT
+        SEGMENT at a time through ``_expand_level_mega``, the hash slab
+        adopted between segments so later segments dedup against
+        earlier segments' children on device.  The generation probe
+        (sieve fast path + exact tier filter) runs PER SEGMENT here —
+        the combined result reports ``tier_done`` so the level tail
+        does not re-probe.  Children seal host-side (trimmed to live
+        rows) and collapse back to one device frontier when the next
+        level fits the budget, else re-segment through the pager.
+        Counts are bit-identical to the unsegmented path: same kernels,
+        same slab, same probes — only the dispatch granularity differs.
+
+        Returns an ``_expand_level_mega``-shaped dict, or the degraded
+        marker (with this level's committed children rolled back OUT of
+        the degraded sorted store, so the staged redo re-finds them)."""
+        tier = self._tier_active()
+        L = self.fseg_rows
+        # hold every parent on host for the degrade-redo (a device seg
+        # would be consumed by the donated dispatch below)
+        for j, s in enumerate(segs):
+            if not isinstance(s, _HostSeg):
+                segs[j] = self._seg_to_host(s, depth)
+        fps_parts, pidx_parts, slot_parts = [], [], []
+        kept_children = []  # per-seg host field dicts, live rows only
+        mult_total = None
+        total_new = 0
+        n_done = 0
+        slab_live = 0
+        cap_out_last = 0
+        abort_global = None
+        bad_global = -1
+        self._mega_stats["seg_levels"] = (
+            self._mega_stats.get("seg_levels", 0) + 1
+        )
+        for j, seg in enumerate(segs):
+            n_seg = min(seg.rows, n_f - n_done)
+            if n_seg <= 0:
+                break
+            mres = self._expand_level_mega(
+                self._seg_to_dev(seg), n_seg, max_depth, level_sizes
+            )
+            if mres.get("degraded"):
+                if fps_parts:
+                    # un-commit the streamed prefix's children from the
+                    # degraded sorted store: the staged redo expands the
+                    # WHOLE level and must re-find them as new (the
+                    # re-heated generation members stay — they fold in
+                    # through the generations and were visited before)
+                    done = np.concatenate(fps_parts)
+                    vb = np.asarray(jax.device_get(self._degraded_visited))
+                    vb = np.setdiff1d(vb[vb != SENT], done)
+                    pad = _cap4(len(vb) + 1) - len(vb)
+                    self._degraded_visited = jnp.concatenate([
+                        jnp.asarray(vb), jnp.full((pad,), SENT, U64),
+                    ])
+                return dict(degraded=True, parent=segs)
+            self._mega_stats["seg_dispatches"] = (
+                self._mega_stats.get("seg_dispatches", 0) + 1
+            )
+            # adopt NOW (kernel-fresh count): the next segment's probe
+            # must see this segment's children as visited
+            self.hstore.adopt(self._hs_pending, mres["n_new"])
+            self._hs_pending = None
+            slab_live = mres["slab_live"]
+            cap_out_last = mres["cap_out"]
+            mult_total = (
+                mres["level_mult"] if mult_total is None
+                else mult_total + mres["level_mult"]
+            )
+            n_new_seg = mres["n_new"]
+            fps = np.asarray(mres["fps"], np.uint64)
+            pidx = mres["pidx"] + n_done
+            slot = mres["slot"]
+            bad_seg = mres["bad_idx"]
+            nf_new = mres["new_frontier"]
+            if mres["abort_at"] < n_seg:
+                # split-brain abort: counts are final, streaming stops
+                # (same early-exit as the unsegmented path's break)
+                abort_global = n_done + mres["abort_at"]
+                break
+            # per-segment tiered tail: sieve fast path, else the exact
+            # generation probe + row compaction (store/tiered.py)
+            if tier and n_new_seg:
+                if (self._sieve_ready()
+                        and mres.get("tier_hits", -1) == 0):
+                    self.tiered.stats["sieve_skips"] = (
+                        self.tiered.stats.get("sieve_skips", 0) + 1
+                    )
+                else:
+                    n_keep, keep, nf_new = self._tier_filter_level(
+                        depth, n_new_seg, fps, nf_new,
+                        nf_new.voted_for.shape[0],
+                    )
+                    if keep is not None:
+                        fps = fps[:n_new_seg][keep]
+                        pidx = pidx[keep]
+                        slot = slot[keep]
+                        if bad_seg >= 0:
+                            assert keep[bad_seg], (
+                                "invariant violation attributed to an "
+                                "already-visited (generation) row"
+                            )
+                            bad_seg = int(
+                                np.count_nonzero(keep[:bad_seg])
+                            )
+                    n_new_seg = n_keep
+            if bad_seg >= 0 and bad_global < 0:
+                bad_global = total_new + bad_seg
+            if n_new_seg:
+                kept_children.append({
+                    f: np.asarray(
+                        jax.device_get(getattr(nf_new, f))
+                    )[:n_new_seg]
+                    for f in Frontier._fields
+                })
+                fps_parts.append(fps[:n_new_seg])
+                pidx_parts.append(pidx[:n_new_seg])
+                slot_parts.append(slot[:n_new_seg])
+                total_new += n_new_seg
+            del nf_new
+            n_done += n_seg
+        # queue the spilled parents' warm-tier artifacts for retirement
+        # at the next level top (never here: a degrade in a LATER call
+        # cannot reach back past the committed level, but this one's
+        # staged redo still can until the commit lands)
+        self._fseg_retire.extend(
+            s.token for s in segs
+            if isinstance(s, _HostSeg) and s.token is not None
+        )
+        empty_u64 = np.empty(0, np.uint64)
+        empty_i64 = np.empty(0, np.int64)
+        out = dict(
+            n_new=total_new,
+            abort_at=n_f if abort_global is None else abort_global,
+            bad_idx=bad_global,
+            slab_live=slab_live,
+            tier_hits=0,
+            tier_done=True,
+            level_mult=(
+                mult_total if mult_total is not None
+                else np.zeros(self.K, np.int64)
+            ),
+            parent=segs,
+            fps=(
+                np.concatenate(fps_parts) if fps_parts else empty_u64
+            ),
+            pidx=(
+                np.concatenate(pidx_parts).astype(np.int64)
+                if pidx_parts else empty_i64
+            ),
+            slot=(
+                np.concatenate(slot_parts).astype(np.int64)
+                if slot_parts else empty_i64
+            ),
+            cap_out=cap_out_last,
+        )
+        if abort_global is not None or total_new == 0:
+            out["new_frontier"] = None  # never read on abort/fixpoint
+            return out
+        # seal the combined child frontier: back to ONE device frontier
+        # while the next level's working set fits, else stay segmented
+        # (uniform L-row host segments, pager-admitted past the budget)
+        row_b = sum(
+            v.dtype.itemsize * int(np.prod(v.shape[1:], dtype=np.int64))
+            for v in kept_children[0].values()
+        )
+        cap_f = self._frontier_cap(total_new)
+        collapse = (
+            total_new <= L
+            or not self.dev_budget
+            or 2 * cap_f * row_b <= self.dev_budget
+        )
+        cols = {
+            f: np.concatenate([c[f] for c in kept_children])
+            for f in Frontier._fields
+        }
+        kept_children = None
+        if collapse:
+            pad = cap_f - total_new
+            out["new_frontier"] = Frontier(**{
+                f: jnp.asarray(np.concatenate([
+                    v, np.zeros((pad,) + v.shape[1:], v.dtype),
+                ]))
+                for f, v in cols.items()
+            })
+            return out
+        n_seg_d = -(-total_new // L)
+        child_segs = []
+        for j in range(n_seg_d):
+            flds = {}
+            for f, v in cols.items():
+                part = v[j * L:(j + 1) * L]
+                if part.shape[0] < L:
+                    part = np.concatenate([
+                        part,
+                        np.zeros((L - part.shape[0],) + part.shape[1:],
+                                 part.dtype),
+                    ])
+                flds[f] = part
+            hs = _HostSeg(flds)
+            self._fseg_admit(hs, depth + 1)
+            child_segs.append(hs)
+        out["new_frontier"] = child_segs
+        return out
+
     # -- multi-level resident supersteps (engine/superstep.py) -------------
 
     def _superstep_span_at(self, max_depth, depth) -> int:
         """The span this superstep may cover: the configured span,
         clamped so the resident loop never expands past --max-depth
         (the per-level loop breaks BEFORE expanding at the cap).
-        Once the tiered store has demoted a generation the span is 1:
-        a resident window cannot host-correct a mid-span level's
-        generation revisits (every level after it would have expanded
-        stale rows), and out-of-core levels are compute-bound anyway —
-        the dispatch floor the superstep amortizes is noise there."""
+        Under spill the full span holds only while the SIEVE covers the
+        demoted generations: a level with zero in-program sieve hits
+        provably has no generation revisits and commits in-window, and
+        a level WITH hits stops on FLAG_TIER for the exact host
+        correction (ops/sieve.py).  With the sieve off the PR 12
+        stand-down applies — span 1, because a resident window cannot
+        host-correct a mid-span level's generation revisits (every
+        level after it would have expanded stale rows)."""
         span = self.superstep_span
-        if self._tier_active():
+        if self._tier_active() and not self._sieve_ready():
             return 1
         if max_depth is not None:
             span = min(span, max_depth - depth)
@@ -1382,8 +1717,9 @@ class JaxChecker:
         # cap_cur (the input frontier's capacity) is part of the traced
         # shape via the in-program padding — a changed input rung is a
         # declared shape event like every other capacity step
+        sieve_dev = self._sieve_operand()
         skey = (cap_cur, cap_f, ring, self.hstore.cap,
-                self.cap_x, self.cap_m)
+                self.cap_x, self.cap_m, int(sieve_dev.shape[0]))
         if graft_sanitize.tracking() and skey != self._ss_sig:
             graft_sanitize.note_shape_event(f"superstep shapes {skey}")
             self._ss_sig = skey
@@ -1399,11 +1735,11 @@ class JaxChecker:
         # device-cost observatory (see the megakernel site)
         graft_devprof.profile_program(
             "superstep.levels", prog,
-            frontier, self.hstore.slab, n_f_dev, span_dev,
+            frontier, self.hstore.slab, n_f_dev, span_dev, sieve_dev,
             statics=dict(cap_f=cap_f, ring=ring),
         )
         outs = prog(
-            frontier, self.hstore.slab, n_f_dev, span_dev,
+            frontier, self.hstore.slab, n_f_dev, span_dev, sieve_dev,
             cap_f=cap_f, ring=ring,
         )
         (fr_out, slab_out, ctrl_d, mn_d, mm_d, rf_d, rp_d,
@@ -1877,13 +2213,20 @@ class JaxChecker:
             slab_b = want * 8
         # expand transient: cv/cf u64 + cp i64 per candidate lane
         lanes_b = (cap_next // self.chunk) * self.cap_x * 24
-        need = slab_b + cap_next * row_b + lanes_b
+        # the spill sieve's device image joins the forecast the moment
+        # tiering is configured: it allocates at FULL size on the first
+        # demotion, so the headroom must exist BEFORE spill starts
+        sieve_b = (
+            graft_forecast.sieve_bytes(self.tiered.dev_bytes)
+            if self.tiered is not None and self.sieve_enabled else 0
+        )
+        need = slab_b + cap_next * row_b + lanes_b + sieve_b
         if need > budget:
             self._pre_oom_level = depth
             graft_obs.pre_oom(
                 depth + 1, need, budget,
                 slab=slab_b, frontier=cap_next * row_b,
-                lanes=lanes_b, rows=nrows,
+                lanes=lanes_b, sieve=sieve_b, rows=nrows,
             )
 
     def _update_presize(self, level_sizes, distinct, max_depth, frontier):
@@ -1964,6 +2307,13 @@ class JaxChecker:
             return []
         plan: list = []
         s_i64 = jax.ShapeDtypeStruct((), jnp.int64)
+        # the fused programs' sieve operand at its CURRENT shape (the
+        # 1-word sentinel pre-spill; the full filter image after — it
+        # is allocated at final size on first demotion, so the shape
+        # the prewarm keys on is the shape the runtime will request)
+        sv_struct = jax.ShapeDtypeStruct(
+            (int(self._sieve_operand().shape[0]),), jnp.uint64
+        )
         final = distinct + sum(rows)
 
         def u64(n):
@@ -2025,12 +2375,13 @@ class JaxChecker:
                 for sc in scaps:
                     plan.append((
                         ("sstep", prev_cap, cap_f, ring, sc, span,
-                         self.cap_x, self.cap_m, self.use_mxu),
+                         self.cap_x, self.cap_m, self.use_mxu,
+                         sv_struct.shape[0]),
                         lambda fs=fs, sc=sc, cap_f=cap_f, ring=ring,
                                prog=prog:
                             prog.lower(
                                 fs, u64(sc), s_i64_n, s_i64_n,
-                                cap_f=cap_f, ring=ring,
+                                sv_struct, cap_f=cap_f, ring=ring,
                             ).compile(),
                     ))
                 prev_cap = cap_f
@@ -2063,10 +2414,11 @@ class JaxChecker:
                 for sc in scaps:
                     plan.append((
                         ("mega", prev_cap, cout, sc, self.cap_x,
-                         self.cap_m, self.use_mxu),
+                         self.cap_m, self.use_mxu, sv_struct.shape[0]),
                         lambda fs=fs, sc=sc, cout=cout:
                             self._mega_prog.lower(
-                                fs, u64(sc), s_i64, cap_out=cout
+                                fs, u64(sc), s_i64, sv_struct,
+                                cap_out=cout
                             ).compile(),
                     ))
                 prev_cap, prev_rows = cout, int(r)
@@ -2392,11 +2744,39 @@ class JaxChecker:
 
     # -- host-RAM segment paging (the level-29 HBM wall breaker) -----------
 
-    def _seg_to_host(self, seg: Frontier) -> _HostSeg:
-        return _HostSeg(
+    def _seg_to_host(self, seg: Frontier, depth: int = -1) -> _HostSeg:
+        hs = _HostSeg(
             {f: np.asarray(jax.device_get(getattr(seg, f)))
              for f in Frontier._fields}
         )
+        self._fseg_admit(hs, depth)
+        return hs
+
+    def _fseg_admit(self, hs: _HostSeg, depth: int = -1) -> None:
+        """Host-budget admission for a paged-out segment: once the
+        RAM-resident host segments exceed TLA_RAFT_FSEG_BYTES, the
+        incoming segment spills straight to the warm tier (kind="fseg")
+        — the walks consume segments in ascending order, so keeping the
+        EARLIER segments resident and spilling the later ones is the
+        moving-window policy (by the time a spilled segment reloads,
+        its predecessors are consumed and freed)."""
+        if self._fpager is None or not self.fseg_host_bytes:
+            return
+        live = [r for r in self._fseg_live if r() is not None]
+        resident = sum(r().resident_bytes for r in live)
+        if resident + hs.resident_bytes > self.fseg_host_bytes:
+            hs.spill(self._fpager, depth)
+            self.paged_disk += 1
+        live.append(weakref.ref(hs))
+        self._fseg_live = live
+
+    def _fseg_retire_consumed(self) -> None:
+        """Drop consumed segments' warm-tier artifacts (level top: the
+        previous level is committed, its parents can never be replayed
+        — a degrade-redo only ever reaches back one level)."""
+        if self._fseg_retire and self._fpager is not None:
+            self._fpager.retire(self._fseg_retire)
+        self._fseg_retire = []
 
     def _seg_to_dev(self, seg) -> Frontier:
         if not isinstance(seg, _HostSeg):
@@ -2816,6 +3196,37 @@ class JaxChecker:
     def _tier_active(self) -> bool:
         """At least one generation demoted: level tails must probe."""
         return self._tier_on() and self.tiered.active
+
+    def _sieve_ready(self) -> bool:
+        """The spill sieve covers every demoted fingerprint: fused
+        levels may rely on zero-hit = provably-clean."""
+        return (
+            self.sieve_enabled and self._tier_active()
+            and self.tiered.spill_sieve is not None
+        )
+
+    def _sieve_operand(self):
+        """The fused programs' sieve operand: the spill sieve's device
+        word image, refreshed exactly when the host filter changed (a
+        demotion), else the cached 1-word all-miss sentinel — ONE
+        traced operand serves both regimes (ops/sieve.py), and jit
+        retraces only when the filter SHAPE changes (it never does:
+        the filter is allocated at full size on first demotion)."""
+        if not self._sieve_ready():
+            if self._dev_sieve_empty is None:
+                self._dev_sieve_empty = graft_sieve.empty_device_sieve()
+            return self._dev_sieve_empty
+        sv = self.tiered.spill_sieve
+        if self._dev_sieve is None or self._dev_sieve_ver != sv.version:
+            self._dev_sieve = jnp.asarray(sv.words)
+            self._dev_sieve_ver = sv.version
+            graft_obs.sieve_refresh(
+                len(self.tiered.gens), len(sv.words), sv.n_added,
+                sv.fp_rate(),
+            )
+            # live-HBM gauge: the filter image is a long-lived buffer
+            graft_obs.buffer("sieve", sv.nbytes)
+        return self._dev_sieve
 
     def _demote_generation(self, depth: int, expected: int = 0) -> None:
         """Flush the hot slab into one warm generation and restart hot.
@@ -3587,6 +3998,14 @@ class JaxChecker:
                 checkpoint_every=checkpoint_every, resume_from=resume_from,
             )
         finally:
+            if self._fpager is not None:
+                # frontier segments are per-level transients: a finished
+                # (or raised) run leaves none worth keeping — resume
+                # rebuilds frontiers from the delta log
+                try:
+                    self._fpager.retire_all()
+                except OSError:
+                    pass  # a torn teardown is sweep_fsegs' problem
             if self.watchdog is not None:
                 self.watchdog.disarm()
             if self._prewarmer is not None:
@@ -3684,15 +4103,15 @@ class JaxChecker:
         # tiered visited store: the hot slab lives under a device-byte
         # budget; demotions spill whole generations to the checkpoint
         # directory (warm in host RAM, cold on disk — store/tiered.py)
+        spill = (
+            checkpoint_dir if (checkpoint_dir and checkpoint_every)
+            else (resume_from if (
+                resume_from and os.path.isdir(resume_from)
+            ) else None)
+        )
         if self.store_bytes and self.use_hashstore and (
             self.host_store is None
         ):
-            spill = (
-                checkpoint_dir if (checkpoint_dir and checkpoint_every)
-                else (resume_from if (
-                    resume_from and os.path.isdir(resume_from)
-                ) else None)
-            )
             self.tiered = graft_tiered.TieredVisitedStore(
                 self.store_bytes, warm_bytes=self.warm_bytes,
                 spill_dir=spill, run_fp=self._run_fp,
@@ -3703,6 +4122,18 @@ class JaxChecker:
                 # source of truth and the resume rebuild re-commits a
                 # fresh, disjoint set
                 graft_tiered.sweep_gens(spill)
+        if spill is not None:
+            # spilled frontiers: host segments past TLA_RAFT_FSEG_BYTES
+            # page through the warm tier (kind="fseg").  Orphans from a
+            # crashed incarnation are per-level transients the delta
+            # log supersedes — swept, never replayed
+            graft_tiered.sweep_fsegs(spill)
+            if self.fseg_host_bytes:
+                self._fpager = graft_tiered.FrontierPager(
+                    spill, run_fp=self._run_fp,
+                )
+        self._fseg_live = []
+        self._fseg_retire = []
         if resume_from is not None:
             if os.path.isdir(resume_from):
                 ck = self._resume_from_deltas(resume_from)
@@ -3879,6 +4310,10 @@ class JaxChecker:
             # drain site (their commit path adopts without the staged
             # between-level grow) ----------------------------------------
             self._tier_drain(depth, n_f)
+            # consumed frontier segments' warm-tier artifacts retire at
+            # the level top: the previous level is committed, nothing
+            # can replay its parents
+            self._fseg_retire_consumed()
             # --- multi-level resident superstep: up to N fused levels
             # in ONE device program + ONE ledgered ring fetch
             # (engine/superstep.py).  A stopped level (abort /
@@ -3983,9 +4418,15 @@ class JaxChecker:
                     self.hstore.adopt(sres["slab"], sres["n_total"])
                     # free conservation check: the driver counted the
                     # returned slab's live slots — they must equal the
-                    # distinct set after the committed prefix
+                    # distinct set after the committed prefix (or, once
+                    # generations exist, the HOT-tier count: the slab
+                    # holds only the post-demotion residue, and every
+                    # committed level was sieve-clean so its fresh
+                    # count is insert-exact)
                     resilience.integrity.occupancy_check(
-                        "device hash slab", sres["slab_live"], distinct,
+                        "device hash slab", sres["slab_live"],
+                        self.hstore.count if self._tier_active()
+                        else distinct,
                         level=depth,
                     )
                 if checkpoint_dir and checkpoint_every and sres["recs"]:
@@ -4080,6 +4521,17 @@ class JaxChecker:
                         self._jit_expand_programs()
                         self._mega_stats["redo_m"] += 1
                         graft_obs.grow("cap_m", self.cap_m)
+                    if flags & graft_superstep.FLAG_TIER:
+                        # in-kernel sieve hits: POSSIBLE spilled
+                        # revisits in the stopped level.  Nothing to
+                        # grow — the per-level replay's tier tail
+                        # performs the exact generation probe (a false
+                        # positive costs exactly this one replay; its
+                        # tier_probe event reports zero revisits)
+                        self._ss_stats["sieve_stops"] = (
+                            self._ss_stats.get("sieve_stops", 0) + 1
+                        )
+                        graft_obs.sieve_stop(depth + 1, -1)
                 if self.watchdog is not None:
                     # a stopped window's elapsed covered only the
                     # committed levels (+ the aborted attempt): keep
@@ -4092,7 +4544,22 @@ class JaxChecker:
             # overflow redoes inside, a mid-level hash-store
             # degradation falls through to the staged path below -----
             mres = None
-            if self._mega_level_ok(frontier, n_f):
+            if self._mega_segs_ok(frontier, n_f):
+                # spilled frontier: stream the parent through the fused
+                # program one segment at a time (cutting an over-budget
+                # device frontier first) — the level runs fused even
+                # when its working set exceeds HBM
+                if not isinstance(frontier, list):
+                    frontier = self._cut_frontier(frontier, n_f, depth)
+                mres = self._expand_level_mega_segs(
+                    frontier, n_f, max_depth, level_sizes, depth
+                )
+                if mres is not None and mres.get("degraded"):
+                    frontier = mres["parent"]
+                    visited = self._degraded_visited
+                    self._degraded_visited = None
+                    mres = None
+            elif self._mega_level_ok(frontier, n_f):
                 mres = self._expand_level_mega(
                     frontier, n_f, max_depth, level_sizes
                 )
@@ -4116,6 +4583,15 @@ class JaxChecker:
             # --- staged fallback: expand + compact-then-dedup (device),
             # fused level fetch ------------------------------------------
             while mres is None:
+                if isinstance(frontier, list) and self.host_store is None:
+                    # staged redo of a segment-streamed level (degrade,
+                    # or megakernel turned off mid-run): page the parent
+                    # segments back in and concat — the staged device
+                    # path wants one frontier, and the degraded route is
+                    # already off the fast path (correctness first)
+                    for i, s in enumerate(frontier):
+                        frontier[i] = self._seg_to_dev(s)
+                    frontier = _concat_fields(frontier)
                 (n_new, new_fps, new_payload, abort_at, overflow, overflow_g,
                  overflow_h, level_mult) = self._expand_level(
                     frontier, n_f, visited,
@@ -4268,7 +4744,24 @@ class JaxChecker:
             n_new_store = n_new  # kernel-fresh (= hot-slab delta) count
             fps_np_lvl = None    # host-side POST-filter level fps
             tier_traced = False  # pidx/slot already host-filtered here
-            if self._tier_active() and n_new:
+            # in-kernel sieve fast path: the fused level counted its
+            # fresh lanes' sieve hits on device — ZERO hits provably
+            # means no spilled revisits (blooms have no false
+            # negatives), so the exact generation probe is skipped
+            # outright (the common case once the working set moves past
+            # the spilled prefix)
+            if mres is not None and mres.get("tier_done"):
+                # segment-streamed level: the sieve fast path / exact
+                # generation probe already ran per segment inside
+                # _expand_level_mega_segs — mres["fps"] is post-filter
+                pass
+            elif (self._tier_active() and n_new and mres is not None
+                    and self._sieve_ready()
+                    and mres.get("tier_hits", -1) == 0):
+                self.tiered.stats["sieve_skips"] = (
+                    self.tiered.stats.get("sieve_skips", 0) + 1
+                )
+            elif self._tier_active() and n_new:
                 if mres is not None:
                     fps_pre = np.asarray(mres["fps"], np.uint64)
                 else:
@@ -4320,7 +4813,9 @@ class JaxChecker:
             # only; production keeps the old drop-at-swap lifetime)
             parent_prev = frontier if self.audit else None
             frontier = new_frontier
-            if resilience.fault_flag("tensor.flip"):
+            if resilience.fault_flag("tensor.flip") and not isinstance(
+                frontier, list
+            ):
                 # injected silent corruption: one bit of the first live
                 # frontier row flips ON DEVICE after materialize — the
                 # recorded fingerprints disagree with the slab from here
@@ -4342,8 +4837,11 @@ class JaxChecker:
                 # the slab also re-heated this level's generation
                 # revisits, so its occupancy delta is n_new_store, not
                 # the post-filter n_new the distinct counter takes
-                self.hstore.adopt(self._hs_pending, n_new_store)
-                self._hs_pending = None
+                # (the segment-streamed path adopted per segment inside
+                # — nothing pending there)
+                if self._hs_pending is not None:
+                    self.hstore.adopt(self._hs_pending, n_new_store)
+                    self._hs_pending = None
                 if mres is not None:
                     # free conservation check: the fused program counted
                     # the pending slab's live slots in its control

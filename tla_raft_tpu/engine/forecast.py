@@ -162,6 +162,17 @@ def pow2_ladder(lo: int, hi: int) -> list[int]:
     return out
 
 
+def sieve_bytes(dev_bytes: int) -> int:
+    """Device bytes the spill sieve will pin once tiering demotes its
+    first generation (ops/sieve.py sieve_words_for: 1/8 of the hot
+    budget by default, TLA_RAFT_SIEVE_BYTES overrides) — charged into
+    the pre-OOM HBM forecast ahead of the first demotion, because the
+    filter is allocated at FULL size the moment spill starts."""
+    from ..ops.sieve import sieve_words_for
+
+    return sieve_words_for(int(dev_bytes)) * 8
+
+
 def forecast_final_distinct(level_sizes, distinct: int,
                             target_depth: int | None) -> int:
     """Forecast total distinct states at the end of the run."""

@@ -82,7 +82,10 @@ CTRL_OVF_M = 4      # a child overflowed the cap_m sparse msg-id width
 CTRL_BAD = 5        # first invariant-violating new row, -1 if none
 CTRL_SLAB_LIVE = 6  # live slots of the pending slab (= distinct', free
 #                     conservation signal for integrity.occupancy_check)
-CTRL_LEN = 7
+CTRL_TIER_HITS = 7  # fresh lanes the spill sieve flagged as POSSIBLE
+#                     generation revisits (0 = provably none: the level
+#                     can commit without any host tier correction)
+CTRL_LEN = 8
 
 
 def enabled_by_env() -> bool:
@@ -164,7 +167,7 @@ def level_program_for(eng, donate: bool):
     return prog
 
 
-def fused_level_core(eng, frontier, slab, n_f, cap_out: int,
+def fused_level_core(eng, frontier, slab, n_f, sieve, cap_out: int,
                      chunk: int, cap_x: int):
     """The traced body of ONE fused BFS level — the shared core both the
     per-level program below and the multi-level superstep driver
@@ -174,14 +177,20 @@ def fused_level_core(eng, frontier, slab, n_f, cap_out: int,
 
     ``chunk``/``cap_x`` are the builder's SNAPSHOT of the engine's
     budgets (the staleness tripwire in the callers compares them
-    against the live engine before tracing).  Returns
+    against the live engine before tracing).  ``sieve`` is the spill
+    sieve's device word image (``u64[M]``, M a power of two; the 1-word
+    all-zero sentinel while tiering is off) — fresh lanes are probed
+    in-program (ops/sieve.py) and the hit count returned, so a level
+    with zero hits provably contains no spilled revisits.  Returns
     ``(new_frontier [cap_out], slab2, n_new i64, abort_at i64,
        ovf_x bool, ovf_slab bool, ovf_m bool, bad_global i64,
-       mult i64[K], fps_out u64[cap_out], pay_out i64[cap_out])``
+       mult i64[K], fps_out u64[cap_out], pay_out i64[cap_out],
+       tier_hits i64)``
     with ``pay_out`` the survivors' raw payloads (pidx*K+slot) in lane
     (= payload-ascending) order.
     """
     from ..ops import hashstore
+    from ..ops import sieve as sieve_mod
 
     K = eng.K
     cap_f = frontier.voted_for.shape[0]
@@ -245,6 +254,12 @@ def fused_level_core(eng, frontier, slab, n_f, cap_out: int,
     fps_out = new_fps[:cap_out]
     pay_out = new_pay[:cap_out]
 
+    # -- spill-sieve probe over the fresh lanes: a definite-miss never
+    # leaves the device; dead (SENT-padded) lanes can never count (a
+    # fresh view fingerprint is never the sentinel)
+    tier_hit = sieve_mod.probe_impl(sieve, fps_out) & (fps_out != SENT)
+    tier_hits = tier_hit.sum().astype(I64)
+
     # -- 3+4. materialize + invariant scan over slice-bounded scan
     # steps.  cap_out is a forecast (it overshoots n_new by design,
     # that is what makes the shape static), so slices wholly beyond
@@ -290,7 +305,7 @@ def fused_level_core(eng, frontier, slab, n_f, cap_out: int,
     bad_global = jnp.where(bad_min >= BIG, jnp.asarray(-1, I64), bad_min)
 
     return (new_frontier, slab2, n_new, abort_at, ovf_x, ovf_slab,
-            ovf_ms.any(), bad_global, mult, fps_out, pay_out)
+            ovf_ms.any(), bad_global, mult, fps_out, pay_out, tier_hits)
 
 
 def build_level_program(eng, donate: bool):
@@ -316,7 +331,7 @@ def build_level_program(eng, donate: bool):
     K = eng.K
     slot_dt = jnp.uint16 if K <= 0xFFFF else jnp.uint32
 
-    def level_body(frontier, slab, n_f, cap_out: int):
+    def level_body(frontier, slab, n_f, sieve, cap_out: int):
         # trace-time staleness tripwire: the body calls the creator
         # engine's methods, which read its LIVE cap_x/chunk — if the
         # creator's budgets drifted from this build's snapshot, a lazy
@@ -331,8 +346,8 @@ def build_level_program(eng, donate: bool):
                 f"{chunk}->{eng.chunk}); re-fetch via level_program_for"
             )
         (new_frontier, slab2, n_new, abort_at, ovf_x, ovf_slab, ovf_m,
-         bad_global, mult, fps_out, pay_out) = fused_level_core(
-            eng, frontier, slab, n_f, cap_out, chunk, cap_x
+         bad_global, mult, fps_out, pay_out, tier_hits) = fused_level_core(
+            eng, frontier, slab, n_f, sieve, cap_out, chunk, cap_x
         )
 
         ctrl = jnp.stack([
@@ -343,6 +358,7 @@ def build_level_program(eng, donate: bool):
             ovf_m.astype(I64),
             bad_global,
             (slab2 != SENT).sum().astype(I64),
+            tier_hits,
         ])
         pidx_out = (pay_out // K).astype(jnp.uint32)
         slot_out = (pay_out % K).astype(slot_dt)
@@ -381,7 +397,8 @@ def ledger_trace(cfg=None):
     fr = eng._frontier_struct(fr0, 64)
     slab = jax.ShapeDtypeStruct((hashstore.MIN_CAP,), jnp.uint64)
     n_f = jax.ShapeDtypeStruct((), jnp.int64)
+    sieve = jax.ShapeDtypeStruct((1,), jnp.uint64)
     prog = build_level_program(eng, donate=False)
     return jax.make_jaxpr(
-        lambda f, s, n: prog(f, s, n, cap_out=64)
-    )(fr, slab, n_f)
+        lambda f, s, n, sv: prog(f, s, n, sv, cap_out=64)
+    )(fr, slab, n_f, sieve)

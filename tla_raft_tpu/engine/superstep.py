@@ -101,6 +101,14 @@ FLAG_BAD = 32       # invariant violation in the stopped level
 # (store/tiered.py; once a generation exists, supersteps stand down to
 # span 1 — the resident loop cannot host-correct mid-window)
 FLAG_OVF_SLAB_TIER = 64
+# the spill sieve flagged POSSIBLE generation revisits in the stopped
+# level (tier_hits > 0): the level is otherwise clean, but its counts
+# are provisional until the host's exact tier probe corrects it — the
+# per-level replay needs NO budget growth, just the tiered filter.
+# Levels with ZERO sieve hits provably contain no spilled revisits
+# (blooms have no false negatives) and commit in-window — that is what
+# restores span-N residency under spill (ops/sieve.py)
+FLAG_TIER = 128
 
 # stop reasons: RUN means the while_loop exhausted its span — every
 # level committed clean (the steady state).  STOP marks an uncommitted
@@ -171,7 +179,10 @@ def build_superstep_program(eng, span: int, donate: bool):
     Static arguments: ``cap_f`` (the one frontier capacity every level
     of the superstep runs at — a chunk multiple >= the input frontier's
     capacity; smaller inputs are zero-padded in-trace) and ``ring``
-    (the trace-spool capacity, >= cap_f).  Returns
+    (the trace-spool capacity, >= cap_f).  ``sieve`` is a traced
+    operand — the spill sieve's device words (the 1-word sentinel while
+    tiering is off); jit retraces automatically when its shape changes,
+    so one cached program serves each filter size.  Returns
 
       ``(frontier_out [cap_f], slab_out, ctrl i64[SS_LEN],
          meta_n i64[span], meta_mult i64[span, K],
@@ -189,7 +200,7 @@ def build_superstep_program(eng, span: int, donate: bool):
     span = int(span)
     slot_dt = jnp.uint16 if K <= 0xFFFF else jnp.uint32
 
-    def superstep_body(frontier, slab, n_f, lvl_cap, cap_f: int,
+    def superstep_body(frontier, slab, n_f, lvl_cap, sieve, cap_f: int,
                        ring: int):
         # trace-time staleness tripwire (see megakernel.level_body)
         if eng.cap_x != cap_x or eng.chunk != chunk:
@@ -236,14 +247,18 @@ def build_superstep_program(eng, span: int, donate: bool):
             (lvl, off, _reason, _flags, n_f, fr, slab, rf, rp, rs, mn,
              mm) = c
             (new_fr, slab2, n_new, abort_at, ovf_x, ovf_slab, ovf_m,
-             bad, mult, fps_out, pay_out) = mk.fused_level_core(
-                eng, fr, slab, n_f, cap_f, chunk, cap_x
+             bad, mult, fps_out, pay_out, tier_hits) = mk.fused_level_core(
+                eng, fr, slab, n_f, sieve, cap_f, chunk, cap_x
             )
             abort = abort_at < n_f
             ovf_out = n_new > cap_f  # next frontier cannot seat
             ring_ovf = off + n_new > R
+            # sieve hits = POSSIBLE spilled revisits: the level must
+            # not commit until the host's exact tier probe corrects it
+            # (zero hits = provably clean, commits in-window)
+            tier_stop = tier_hits > 0
             stop = (abort | ovf_x | ovf_slab | (ovf_m & (n_new > 0))
-                    | ovf_out | (bad >= 0))
+                    | ovf_out | (bad >= 0) | tier_stop)
             commit = ~stop & ~ring_ovf
             # ring append: drop-mode scatter at the dynamic offset —
             # writes beyond the committed prefix (an uncommitted
@@ -276,6 +291,7 @@ def build_superstep_program(eng, span: int, donate: bool):
                 + ovf_out.astype(I32) * FLAG_OVF_OUT
                 + abort.astype(I32) * FLAG_ABORT
                 + (bad >= 0).astype(I32) * FLAG_BAD
+                + tier_stop.astype(I32) * FLAG_TIER
             )
             sel = lambda a, b: jnp.where(commit, a, b)  # noqa: E731
             fr2 = jax.tree.map(sel, new_fr, fr)
@@ -404,7 +420,8 @@ def ledger_trace(cfg=None, span: int = 2):
     fr = eng._frontier_struct(fr0, 64)
     slab = jax.ShapeDtypeStruct((hashstore.MIN_CAP,), jnp.uint64)
     n_f = jax.ShapeDtypeStruct((), jnp.int64)
+    sieve = jax.ShapeDtypeStruct((1,), jnp.uint64)
     prog = build_superstep_program(eng, span, donate=False)
     return jax.make_jaxpr(
-        lambda f, s, n, lc: prog(f, s, n, lc, cap_f=64, ring=128)
-    )(fr, slab, n_f, jax.ShapeDtypeStruct((), jnp.int64))
+        lambda f, s, n, lc, sv: prog(f, s, n, lc, sv, cap_f=64, ring=128)
+    )(fr, slab, n_f, jax.ShapeDtypeStruct((), jnp.int64), sieve)

@@ -78,6 +78,8 @@ def _load():
         lib.fpstore_count.argtypes = [ctypes.c_void_p]
         lib.fpstore_num_runs.restype = ctypes.c_uint64
         lib.fpstore_num_runs.argtypes = [ctypes.c_void_p]
+        lib.fpstore_bloom_skips.restype = ctypes.c_uint64
+        lib.fpstore_bloom_skips.argtypes = [ctypes.c_void_p]
         lib.fpstore_contains.restype = None
         lib.fpstore_contains.argtypes = [
             ctypes.c_void_p,
@@ -115,6 +117,14 @@ class HostFPStore:
     @property
     def num_runs(self) -> int:
         return int(self._lib.fpstore_num_runs(self._h))
+
+    @property
+    def bloom_skips(self) -> int:
+        """Run binary searches avoided by the per-run blooms (built at
+        spill time, in-memory only — see fpstore.cpp).  Bloom hits are
+        not proof of membership, so the filter only short-circuits the
+        per-run search; it never feeds the phase-1 drop."""
+        return int(self._lib.fpstore_bloom_skips(self._h))
 
     def _ptrs(self, fps: np.ndarray):
         fps = np.ascontiguousarray(fps, np.uint64)

@@ -36,7 +36,53 @@ struct Run {
   size_t n = 0;
   int fd = -1;
   std::string path;
+  // per-run blocked bloom (ops/sieve.py's C++ twin: one u64 word per
+  // block, 4 bits from disjoint 6-bit fields of a salted second mix).
+  // Built in memory at write_run, never persisted: a reopened store
+  // starts empty (resume rebuilds from the delta log), so the filter's
+  // lifetime matches the mmap's.  No false negatives — a miss skips
+  // the run's binary search outright.
+  std::vector<uint64_t> bloom;
+  uint64_t bloom_mask = 0;  // word-index mask (size - 1)
 };
+
+constexpr uint64_t kBloomSalt = 0x9E3779B97F4A7C15ull;
+constexpr int kBloomBits = 4;
+
+uint64_t mix64(uint64_t x) {
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D9ECA592EAF335ull;
+  return x ^ (x >> 31);
+}
+
+void bloom_word_mask(uint64_t fp, uint64_t& word, uint64_t& mask) {
+  word = mix64(fp);
+  uint64_t h2 = mix64(fp ^ kBloomSalt);
+  mask = 0;
+  for (int i = 0; i < kBloomBits; i++)
+    mask |= 1ull << ((h2 >> (6 * i)) & 63);
+}
+
+void bloom_build(Run& r) {
+  // ~8 bits/key design load (64-bit words, one word per 8 keys),
+  // power-of-two so the block index is a mask; floored at 64 words
+  size_t words = 64;
+  while (words * 8 < r.n) words <<= 1;
+  r.bloom.assign(words, 0);
+  r.bloom_mask = words - 1;
+  for (size_t i = 0; i < r.n; i++) {
+    uint64_t w, m;
+    bloom_word_mask(r.data[i], w, m);
+    r.bloom[w & r.bloom_mask] |= m;
+  }
+}
+
+bool bloom_maybe(const Run& r, uint64_t fp) {
+  if (r.bloom.empty()) return true;  // no filter: must search
+  uint64_t w, m;
+  bloom_word_mask(fp, w, m);
+  return (r.bloom[w & r.bloom_mask] & m) == m;
+}
 
 struct FPStore {
   std::string dir;
@@ -45,6 +91,7 @@ struct FPStore {
   std::vector<Run> runs;       // on-disk sorted runs, newest last
   size_t total = 0;            // total unique fingerprints
   int next_run_id = 0;
+  uint64_t bloom_skips = 0;    // run binary searches avoided by blooms
 };
 
 bool contains_sorted(const uint64_t* a, size_t n, uint64_t x) {
@@ -70,7 +117,8 @@ int write_run(FPStore* s, const std::vector<uint64_t>& v) {
   r.n = v.size();
   r.fd = fd;
   r.path = path;
-  s->runs.push_back(r);
+  bloom_build(r);
+  s->runs.push_back(std::move(r));
   return 0;
 }
 
@@ -125,6 +173,7 @@ FPStore* fpstore_open(const char* dir, uint64_t mem_budget_entries) {
 
 uint64_t fpstore_count(FPStore* s) { return s->total; }
 uint64_t fpstore_num_runs(FPStore* s) { return s->runs.size(); }
+uint64_t fpstore_bloom_skips(FPStore* s) { return s->bloom_skips; }
 
 // For each query: out[i] = 1 if fps[i] already present, else 0.
 // Does NOT insert.
@@ -133,8 +182,10 @@ void fpstore_contains(FPStore* s, const uint64_t* fps, uint64_t n,
   for (uint64_t i = 0; i < n; i++) {
     uint64_t x = fps[i];
     bool hit = contains_sorted(s->mem.data(), s->mem.size(), x);
-    for (auto it = s->runs.rbegin(); !hit && it != s->runs.rend(); ++it)
+    for (auto it = s->runs.rbegin(); !hit && it != s->runs.rend(); ++it) {
+      if (!bloom_maybe(*it, x)) { s->bloom_skips++; continue; }
       hit = contains_sorted(it->data, it->n, x);
+    }
     out[i] = hit ? 1 : 0;
   }
 }
@@ -149,8 +200,10 @@ uint64_t fpstore_insert(FPStore* s, const uint64_t* fps, uint64_t n,
   for (uint64_t i = 0; i < n; i++) {
     uint64_t x = fps[i];
     bool hit = contains_sorted(s->mem.data(), s->mem.size(), x);
-    for (auto it = s->runs.rbegin(); !hit && it != s->runs.rend(); ++it)
+    for (auto it = s->runs.rbegin(); !hit && it != s->runs.rend(); ++it) {
+      if (!bloom_maybe(*it, x)) { s->bloom_skips++; continue; }
       hit = contains_sorted(it->data, it->n, x);
+    }
     if (out) out[i] = hit ? 0 : 1;
     if (!hit) fresh.push_back(x);
   }

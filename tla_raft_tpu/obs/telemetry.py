@@ -863,6 +863,49 @@ def tier_probe(level, lanes, hits, sieve: int = 0,
                  sieve=sieve, s=round(wait_s, 6))
 
 
+def tier_compact(level, runs, n, seconds) -> None:
+    """One LSM generation merge (store/tiered.py _maybe_compact):
+    ``runs`` cold runs folded into one ``n``-fingerprint sorted run
+    (+ its bloom side-car) in ``seconds`` of host wall."""
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("tier_compact", level=level, runs=runs, n=n,
+                 s=round(seconds, 6))
+
+
+def sieve_refresh(level, words, n_added, fp_rate) -> None:
+    """The engine re-uploaded the spill sieve to the device (a demotion
+    bumped the host filter's version): filter size, keys added, and the
+    predicted false-positive rate at the new load."""
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("sieve_refresh", level=level, words=int(words),
+                 n=int(n_added), fp_rate=round(float(fp_rate), 6))
+
+
+def sieve_stop(level, hits) -> None:
+    """A resident superstep stopped on in-kernel sieve hits (FLAG_TIER):
+    the stopped level replays per-level through the exact generation
+    probe.  ``hits`` is the device-counted filter-hit lanes (true
+    revisits + false positives; the replay's tier_probe event tells
+    them apart), or -1 when the stop path did not fetch the count (the
+    superstep control vector carries only the FLAG_TIER bit)."""
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("sieve_stop", level=level, hits=int(hits))
+
+
+def fseg_page(token, rows, seconds) -> None:
+    """One spilled frontier segment paged back from the warm tier
+    (store/tiered.py FrontierPager.load); the matching spill is already
+    visible as the ``checkpoint`` event its ``kind="fseg"`` commit
+    emits."""
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("fseg_page", token=int(token), rows=int(rows),
+                 s=round(seconds, 6))
+
+
 def program_profile(tag: str, **metrics) -> None:
     """One compiled program's XLA cost/memory ledger (flops, bytes
     accessed, argument/output/temp/code bytes) — published from the

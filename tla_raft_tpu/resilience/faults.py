@@ -56,14 +56,26 @@ FAULT_SITES = {
     "mdelta.commit": "mesh delta record: renamed, not manifested",
     "hslab.tmp": "hash-slab snapshot: tmp written, not renamed",
     "hslab.commit": "hash-slab snapshot: renamed, not manifested",
-    "sieve.tmp": "sieve-slab snapshot: tmp written, not renamed",
-    "sieve.commit": "sieve-slab snapshot: renamed, not manifested",
+    "sieve.tmp": "sieve snapshot / generation bloom side-car: tmp "
+                 "written, not renamed",
+    "sieve.commit": "sieve snapshot / generation bloom side-car: "
+                    "renamed, not manifested (flip/torn here = the "
+                    "corrupt-side-car quarantine-and-rebuild case)",
     "monolith.tmp": "monolith snapshot: tmp written, not renamed",
     "monolith.commit": "monolith snapshot: renamed, not manifested",
     "gen.tmp": "tiered-store generation run: tmp written, not renamed "
                "(a kill mid-demotion; resume rebuilds every tier from "
                "the delta log)",
     "gen.commit": "tiered-store generation run: renamed, not manifested",
+    "compact.tmp": "tiered-store LSM-merged run: tmp written, not "
+                   "renamed (a kill mid-compaction; the input runs are "
+                   "still live — resume sweeps and rebuilds, never "
+                   "double-counting)",
+    "compact.commit": "tiered-store LSM-merged run: renamed, not "
+                      "manifested (both the merged run and its inputs "
+                      "are on disk until the discard lands)",
+    "fseg.tmp": "spilled frontier segment: tmp written, not renamed",
+    "fseg.commit": "spilled frontier segment: renamed, not manifested",
     "base.commit": "base monolith copied into a delta dir, not manifested",
     "manifest.commit": "manifest json: tmp written, not renamed",
     "hashstore.grow": "the Nth visited-slab grow/rehash",

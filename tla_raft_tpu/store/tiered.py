@@ -91,6 +91,37 @@ DEFAULT_WARM_BYTES = 1 << 30
 # generation probe, never correctness)
 SIEVE_MAX = 1 << 20
 
+# generation side-car suffix: the per-run bloom filter persisted beside
+# each committed gen_*.npz (ops/sieve.py), probed before a cold disk
+# load so the level tail touches disk only on likely hits
+SIDECAR_SUFFIX = ".sieve.npz"
+
+# LSM compaction fanout: once the COLD run count exceeds it, every cold
+# generation merges into one sorted run (full-level compaction, the
+# same policy the native host store applies to its run files at 16 —
+# native/fpstore.cpp), bounding both the per-probe run walk and the
+# open-file count of a billion-state sweep
+DEFAULT_COMPACT_FANOUT = 8
+
+
+# spilled-frontier segment files: one npz per demoted frontier segment
+# (kind="fseg" through the atomic writer), committed by FrontierPager
+# when a level's frontier working set outgrows the host budget
+FSEG_PREFIX = "fseg_"
+
+
+def compact_fanout_from_env() -> int:
+    v = os.environ.get("TLA_RAFT_COMPACT_FANOUT")
+    return max(1, int(v)) if v else DEFAULT_COMPACT_FANOUT
+
+
+def fseg_bytes_from_env() -> int:
+    """Host-RAM budget for paged-out frontier segments before they
+    spill on to the warm tier (``TLA_RAFT_FSEG_BYTES``; 0 = disk spill
+    off, host RAM is the only frontier overflow tier)."""
+    v = os.environ.get("TLA_RAFT_FSEG_BYTES")
+    return int(float(v)) if v else 0
+
 
 def store_bytes_from_env() -> int:
     """The hot-tier device budget: ``TLA_RAFT_STORE_BYTES`` (bytes; 0 =
@@ -109,10 +140,13 @@ class Generation:
 
     ``fps`` is the warm residency (None when cold — the committed file
     at ``path`` is then the only copy); ``lo``/``hi`` give the free
-    range reject, ``(part_d, owner)`` the fp % D partition tag."""
+    range reject, ``(part_d, owner)`` the fp % D partition tag.
+    ``sidecar`` is the run's bloom filter (ops/sieve.py SpillSieve,
+    ~1.5 B/key), lazily loaded from ``sidecar_path`` and rebuilt from
+    the generation itself when the persisted copy is torn or stale."""
 
     __slots__ = ("gid", "n", "lo", "hi", "fps", "path", "part_d",
-                 "owner", "depth")
+                 "owner", "depth", "sidecar", "sidecar_path")
 
     def __init__(self, gid: int, fps: np.ndarray, *, path=None,
                  part_d: int = 1, owner: int = 0, depth: int = 0):
@@ -126,6 +160,8 @@ class Generation:
         self.part_d = part_d
         self.owner = owner
         self.depth = depth
+        self.sidecar = None
+        self.sidecar_path = None
 
     @property
     def nbytes(self) -> int:
@@ -178,6 +214,16 @@ class TieredVisitedStore:
         self.gens: list[Generation] = []
         self._next_gid = 0
         self.sieve = np.empty(0, np.uint64)
+        # the device-resident spill sieve (ops/sieve.py): ONE blocked
+        # bloom over EVERY demoted fingerprint, allocated at full size
+        # on the first demotion (growing a bloom would re-hash every
+        # spilled fp — cold reloads — so it trades graceful fp-rate
+        # degradation past design load for never touching disk) and fed
+        # at demote time.  The engine uploads ``spill_sieve.words`` and
+        # refreshes on ``version`` bumps; its in-kernel probe is what
+        # lets supersteps hold span N under spill.
+        self.spill_sieve = None
+        self.compact_fanout = compact_fanout_from_env()
         # cold page cache: gid -> fps, LRU-bounded by the warm budget
         # (a loaded cold run is warm residency like any other)
         self._cold_cache: OrderedDict[int, np.ndarray] = OrderedDict()
@@ -187,6 +233,8 @@ class TieredVisitedStore:
             sieve_hits=0, warm_hits=0, cold_hits=0,
             cold_loads=0, cold_load_s=0.0, probe_wait_s=0.0,
             reheats=0, tier_redos=0,
+            compactions=0, compact_runs=0, compact_s=0.0,
+            sidecar_skips=0, sidecar_rebuilds=0,
         )
 
     # -- policy -----------------------------------------------------------
@@ -238,7 +286,11 @@ class TieredVisitedStore:
         the spill directory through the atomic writer (crash at any
         point leaves the delta log authoritative — a resumed run
         discards and rebuilds every generation), then the warm budget
-        evicts the oldest warm residencies to cold."""
+        evicts the oldest warm residencies to cold and the LSM
+        compaction bound merges the cold runs when they outgrow the
+        fanout.  Every demoted fingerprint also lands in the global
+        spill sieve (the device-resident filter) and the run's bloom
+        side-car commits beside it."""
         t0 = time.monotonic()
         fps = np.asarray(fps, np.uint64)
         fps = np.unique(fps[fps != SENT])
@@ -247,6 +299,14 @@ class TieredVisitedStore:
             depth=depth,
         )
         self._next_gid += 1
+        if gen.n:
+            if self.spill_sieve is None:
+                from ..ops import sieve as sieve_mod
+
+                self.spill_sieve = sieve_mod.SpillSieve(
+                    sieve_mod.sieve_words_for(self.dev_bytes)
+                )
+            self.spill_sieve.add(fps)
         if self.spill_dir is not None and gen.n:
             from .. import resilience
 
@@ -263,16 +323,181 @@ class TieredVisitedStore:
                 ),
                 kind="gen", depth=depth, run_fp=self.run_fp,
             )
+            self._commit_sidecar(gen, depth)
         if gen.n:
             self.gens.append(gen)
         self.stats["demotions"] += 1
         self.stats["spilled"] += gen.n
         self._enforce_warm()
+        self._maybe_compact(depth)
         _obs.tier_demote(
             depth, gen.n, gen.gid, time.monotonic() - t0,
             cold=gen.cold,
         )
         return gen
+
+    def _commit_sidecar(self, gen: Generation, depth: int) -> None:
+        """Build the run's bloom side-car and commit it beside the run
+        (kind ``sieve`` -> the ``sieve.tmp``/``sieve.commit`` fault
+        sites).  Pure acceleration state: a torn/stale/lost side-car
+        quarantines and rebuilds from the generation itself, never
+        affecting membership."""
+        from .. import resilience
+        from ..ops import sieve as sieve_mod
+
+        gen.sidecar = sieve_mod.SpillSieve.build(gen.fps)
+        name = f"{GEN_PREFIX}{gen.gid:04d}{SIDECAR_SUFFIX}"
+        gen.sidecar_path = resilience.commit_npz(
+            self.spill_dir, name,
+            dict(
+                words=gen.sidecar.words,
+                meta=np.asarray(
+                    [sieve_mod.SIEVE_VERSION, gen.gid, gen.n,
+                     len(gen.sidecar.words)],
+                    np.int64,
+                ),
+            ),
+            kind="sieve", depth=depth, run_fp=self.run_fp,
+        )
+
+    def _maybe_compact(self, depth: int = 0) -> None:
+        """LSM merge: when the COLD run count exceeds the fanout, merge
+        every cold generation into one sorted run (committed kind
+        ``compact`` -> the ``compact.tmp``/``compact.commit`` fault
+        sites) with a fresh bloom side-car, then discard the inputs.
+        Commit-then-discard order makes a kill at any instruction safe:
+        resume sweeps ALL ``gen_*`` files and rebuilds the tier layout
+        from the delta log, so a crash can never double-count a
+        generation; in-process, ``self.gens`` swaps only after the
+        merged run is durable.  Full-level merge (not size-tiered):
+        write amplification is bounded by the fanout trigger itself —
+        each spilled fp is rewritten at most once per fanout's worth of
+        new cold runs — and the probe walk shrinks to <= fanout runs
+        plus the warm tail."""
+        cold = [g for g in self.gens if g.cold]
+        if len(cold) <= self.compact_fanout or self.spill_dir is None:
+            return
+        from .. import resilience
+        from ..ops import sieve as sieve_mod
+
+        t0 = time.monotonic()
+        merged = np.unique(
+            np.concatenate([self._gen_fps(g) for g in cold])
+        )
+        gen = Generation(
+            self._next_gid, merged, part_d=self.part_d,
+            owner=self.owner, depth=depth,
+        )
+        self._next_gid += 1
+        name = f"{GEN_PREFIX}{gen.gid:04d}.npz"
+        gen.path = resilience.commit_npz(
+            self.spill_dir, name,
+            dict(
+                fps=merged,
+                meta=np.asarray(
+                    [GEN_VERSION, gen.gid, gen.n, depth,
+                     self.part_d, self.owner],
+                    np.int64,
+                ),
+            ),
+            kind="compact", depth=depth, run_fp=self.run_fp,
+        )
+        gen.sidecar = sieve_mod.SpillSieve.build(merged)
+        gen.sidecar_path = resilience.commit_npz(
+            self.spill_dir, f"{GEN_PREFIX}{gen.gid:04d}{SIDECAR_SUFFIX}",
+            dict(
+                words=gen.sidecar.words,
+                meta=np.asarray(
+                    [sieve_mod.SIEVE_VERSION, gen.gid, gen.n,
+                     len(gen.sidecar.words)],
+                    np.int64,
+                ),
+            ),
+            kind="sieve", depth=depth, run_fp=self.run_fp,
+        )
+        # the merged run is durable — NOW swap the in-memory view and
+        # discard the inputs (their side-cars ride along)
+        drop_names = []
+        for g in cold:
+            if g.path is not None:
+                drop_names.append(os.path.basename(g.path))
+            if g.sidecar_path is not None:
+                drop_names.append(os.path.basename(g.sidecar_path))
+            self._cold_cache.pop(g.gid, None)
+        cold_ids = {g.gid for g in cold}
+        self.gens = [gen] + [
+            g for g in self.gens if g.gid not in cold_ids
+        ]
+        if drop_names:
+            resilience.discard_artifacts(self.spill_dir, drop_names)
+        self._enforce_warm()
+        dt = time.monotonic() - t0
+        self.stats["compactions"] += 1
+        self.stats["compact_runs"] += len(cold)
+        self.stats["compact_s"] += dt
+        _obs.tier_compact(depth, len(cold), gen.n, dt)
+
+    # -- side-cars --------------------------------------------------------
+
+    def _gen_sidecar(self, g: Generation):
+        """The run's bloom filter, or None when unavailable.
+
+        Warm-held side-cars return instantly; a committed one lazily
+        loads with full validation — manifest digest (catches torn and
+        flipped bytes after commit), format version and (gid, n, words)
+        meta (catches a stale side-car adopted across a crashed
+        compaction).  ANY failure quarantines the file and REBUILDS the
+        filter from the generation itself (one disk load — the same
+        cost a missing side-car always had), so a bad side-car can
+        never manufacture a false negative."""
+        if g.sidecar is not None:
+            return g.sidecar
+        if g.sidecar_path is None:
+            return None
+        from ..ops import sieve as sieve_mod
+        from ..resilience import manifest as _manifest
+
+        name = os.path.basename(g.sidecar_path)
+        try:
+            state = _manifest.Manifest.load(
+                os.path.dirname(g.sidecar_path)
+            ).verify(name)
+            if state != "ok":
+                raise IOError(f"side-car {name}: manifest says {state}")
+            with np.load(g.sidecar_path) as z:
+                words = np.asarray(z["words"], np.uint64)
+                meta = np.asarray(z["meta"], np.int64)
+            if (
+                meta[0] != sieve_mod.SIEVE_VERSION or meta[1] != g.gid
+                or meta[2] != g.n or meta[3] != len(words)
+                or len(words) == 0 or len(words) & (len(words) - 1)
+            ):
+                raise IOError(
+                    f"side-car {name}: stale meta {meta.tolist()} for "
+                    f"generation (gid={g.gid}, n={g.n})"
+                )
+            g.sidecar = sieve_mod.SpillSieve.from_words(
+                words, n_added=int(meta[2])
+            )
+        except Exception as e:  # graftlint: waive[GL003] — a side-car
+            # is acceleration state with a full fallback: quarantine
+            # whatever failed (digest, zip, meta) and rebuild from the
+            # generation run, which IS membership-authoritative
+            import sys
+
+            print(
+                f"[tiered] side-car {name} quarantined ({e}); "
+                "rebuilding from the generation run", file=sys.stderr,
+            )
+            from .. import resilience
+
+            resilience.discard_artifacts(
+                os.path.dirname(g.sidecar_path), [name]
+            )
+            g.sidecar_path = None
+            g.sidecar = sieve_mod.SpillSieve.build(self._gen_fps(g))
+            self.stats["sidecar_rebuilds"] += 1
+        return g.sidecar
 
     def _enforce_warm(self) -> None:
         """Evict the oldest warm generations to cold (disk-only) until
@@ -343,6 +568,16 @@ class TieredVisitedStore:
             if not inr.any():
                 continue
             was_cold = g.fps is None and g.gid not in self._cold_cache
+            if was_cold:
+                # bloom side-car first: a definite miss for every
+                # in-range lane means the disk run CANNOT hold any of
+                # them (no false negatives) — skip the cold load
+                # entirely; a filter hit (true or false positive) pays
+                # the exact searchsorted probe below
+                sc = self._gen_sidecar(g)
+                if sc is not None and not sc.contains(fps[inr]).any():
+                    self.stats["sidecar_skips"] += 1
+                    continue
             run = self._gen_fps(g)
             pos = np.searchsorted(run, fps[inr])
             gh = run[np.clip(pos, 0, len(run) - 1)] == fps[inr]
@@ -417,9 +652,17 @@ class TieredVisitedStore:
 
 
 def sweep_gens(ckdir: str) -> int:
-    """Discard every committed generation file in a checkpoint
-    directory (resume rebuilds the tier layout from the delta log, so
-    stale runs from the crashed incarnation are noise)."""
+    """Discard every committed generation file AND bloom side-car in a
+    checkpoint directory (the ``gen_*.npz`` glob matches
+    ``gen_*.sieve.npz`` too).  Resume rebuilds the tier layout from the
+    delta log, so stale runs from the crashed incarnation are noise —
+    and sweeping them FIRST is what makes a kill mid-compaction safe:
+    the commit-then-discard window can leave both the merged run and
+    its inputs on disk, and only this sweep guarantees the overlapping
+    sets never double-count (the resume re-demotes a fresh, disjoint
+    generation sequence).  Orphaned ``.tmp_*`` files are the atomic
+    writer's own sweep; this extends that hygiene to the committed-but-
+    stale class."""
     import glob
 
     from .. import resilience
@@ -427,6 +670,104 @@ def sweep_gens(ckdir: str) -> int:
     names = [
         os.path.basename(f)
         for f in glob.glob(os.path.join(ckdir, f"{GEN_PREFIX}*.npz"))
+    ]
+    if names:
+        resilience.discard_artifacts(ckdir, names)
+    return len(names)
+
+
+class FrontierPager:
+    """Warm-tier paging for frontier segments (``kind="fseg"``).
+
+    The visited tiers bound the SLAB's residency; this pager bounds the
+    FRONTIER's.  A deep level's working set is (parent segments +
+    sealed child segments); once the engine's host-RAM paging
+    (`engine/bfs._HostSeg`) itself outgrows ``TLA_RAFT_FSEG_BYTES``,
+    the overflow segments commit here through the same atomic
+    ``commit_npz`` machinery the generations use — crash mid-write
+    leaves only a ``.tmp_*`` the writer's own sweep removes, a
+    committed-but-orphaned segment is swept on resume
+    (:func:`sweep_fsegs`; the delta log rebuilds frontiers, so fseg
+    files are NEVER a recovery input).  Spilled segments reload on
+    demand when the next level's walk reaches them — the walks consume
+    segments in ascending order, so residency is a moving window over
+    the level, not the whole level.
+
+    All methods are host-side numpy (no device dispatch, GL007).
+    """
+
+    def __init__(self, spill_dir: str, *, run_fp: str | None = None):
+        self.spill_dir = spill_dir
+        self.run_fp = run_fp
+        self._next_tok = 0
+        self._names: dict[int, str] = {}
+        self.stats = dict(
+            fseg_spills=0, fseg_loads=0, fseg_bytes=0,
+            fseg_load_s=0.0, fseg_live_peak=0,
+        )
+
+    @property
+    def live(self) -> int:
+        return len(self._names)
+
+    def spill(self, fields: dict, *, depth: int = -1) -> int:
+        """Commit one frontier segment's field dict; returns a token."""
+        from .. import resilience
+
+        tok = self._next_tok
+        self._next_tok += 1
+        name = f"{FSEG_PREFIX}{tok:05d}.npz"
+        resilience.commit_npz(
+            self.spill_dir, name, dict(fields), kind="fseg",
+            depth=depth, run_fp=self.run_fp,
+        )
+        self._names[tok] = name
+        self.stats["fseg_spills"] += 1
+        self.stats["fseg_bytes"] += sum(
+            int(np.prod(v.shape)) * v.dtype.itemsize
+            for v in fields.values()
+        )
+        self.stats["fseg_live_peak"] = max(
+            self.stats["fseg_live_peak"], self.live
+        )
+        return tok
+
+    def load(self, tok: int) -> dict:
+        """Page one spilled segment back into host RAM."""
+        t0 = time.monotonic()
+        path = os.path.join(self.spill_dir, self._names[tok])
+        with np.load(path) as z:
+            fields = {k: z[k] for k in z.files}
+        self.stats["fseg_loads"] += 1
+        self.stats["fseg_load_s"] += time.monotonic() - t0
+        _obs.fseg_page(tok, fields["voted_for"].shape[0],
+                       time.monotonic() - t0)
+        return fields
+
+    def retire(self, toks) -> None:
+        """Discard consumed segments' artifacts (one manifest commit)."""
+        from .. import resilience
+
+        names = [self._names.pop(t) for t in toks if t in self._names]
+        if names:
+            resilience.discard_artifacts(self.spill_dir, names)
+
+    def retire_all(self) -> None:
+        self.retire(list(self._names))
+
+
+def sweep_fsegs(ckdir: str) -> int:
+    """Discard every committed frontier-segment file in a checkpoint
+    directory.  Frontier segments are per-level transients — resume
+    rebuilds the frontier from the delta log, so fseg files from a
+    crashed incarnation are pure noise (and, unswept, dead disk)."""
+    import glob
+
+    from .. import resilience
+
+    names = [
+        os.path.basename(f)
+        for f in glob.glob(os.path.join(ckdir, f"{FSEG_PREFIX}*.npz"))
     ]
     if names:
         resilience.discard_artifacts(ckdir, names)
