@@ -433,6 +433,157 @@ def _bench_service(jax) -> int:
     return 0 if parity else 1
 
 
+def _bench_pool(jax) -> int:
+    """BENCH_POOL=N: worker-pool drain scaling — jobs/hour at 1..N
+    workers over the same synthetic queue (ISSUE 19).
+
+    Each arm submits the identical deterministic job set to a fresh
+    queue root and drains it with n REAL worker processes
+    (``python -m tla_raft_tpu.service run --once --worker workerK``),
+    all sharing one persistent compile cache that an untimed priming
+    drain fills first — the arms measure drain wall, not the one-time
+    compile ladder.  Per-job results must be bit-identical across ALL
+    arms (the pool must never buy throughput with correctness).
+    Knobs: BENCH_POOL_JOBS (default 24), BENCH_POOL_MR_WIDTH (6),
+    BENCH_POOL_SEED, BENCH_POOL_CHUNK, BENCH_POOL_ROOT (keep dirs).
+
+    Scaling expectation is HOST-RELATIVE: on an N-core host the pool
+    scales toward Nx; on a single-core host the workers time-slice one
+    CPU and jobs/h stays ~flat (the record's config string names the
+    cpu count so the trend gate compares like with like).
+    """
+    import shutil
+    import subprocess
+    import tempfile
+
+    from tla_raft_tpu.service.chaos import PARITY_KEYS, _job_set, _submit
+    from tla_raft_tpu.service.queue import JobQueue
+
+    try:
+        n_max = int(os.environ.get("BENCH_POOL", "0"))
+        n_jobs = int(os.environ.get("BENCH_POOL_JOBS", "24"))
+        seed = int(os.environ.get("BENCH_POOL_SEED", "1"))
+        mr_width = int(os.environ.get("BENCH_POOL_MR_WIDTH", "6"))
+        chunk = int(os.environ.get("BENCH_POOL_CHUNK", "64"))
+        keep_root = os.environ.get("BENCH_POOL_ROOT")
+        base = keep_root or tempfile.mkdtemp(prefix="bench_pool_")
+        cache = os.path.join(base, "cache")
+        jobs = _job_set(n_jobs, seed, mr_width, chunk, 0)
+    except Exception as e:
+        _emit_failure("bench_setup", e, unit="jobs_per_hour")
+        return 1
+
+    def drain(n_workers: int, root: str) -> tuple[float, dict]:
+        jids = _submit(root, jobs)
+        env = dict(os.environ, TLA_RAFT_COMPILE_CACHE=cache)
+        env.pop("BENCH_POOL", None)
+        t0 = time.monotonic()
+        procs, logfs = [], []
+        for i in range(n_workers):
+            lf = open(os.path.join(root, f"worker{i + 1}.log"), "w")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tla_raft_tpu.service", "run",
+                 "--root", root, "--worker", f"worker{i + 1}",
+                 "--once", "--min-bucket", "2", "--lease-ttl", "60"],
+                env=env, stdout=lf, stderr=lf,
+            ))
+            logfs.append(lf)
+        try:
+            for p in procs:
+                p.wait(timeout=3600)
+        finally:
+            for lf in logfs:
+                lf.close()
+        wall = time.monotonic() - t0
+        bad = [p.returncode for p in procs if p.returncode != 0]
+        if bad:
+            raise RuntimeError(f"pool arm {n_workers}w: worker "
+                               f"exit(s) {bad}")
+        q = JobQueue(root)
+        res = {j: q.load_result(j) for j in jids}
+        missing = [j for j, r in res.items() if r is None]
+        if missing:
+            raise RuntimeError(
+                f"pool arm {n_workers}w left {len(missing)} job(s) "
+                f"undrained: {missing[:5]}"
+            )
+        return wall, res
+
+    try:
+        # untimed priming drain fills the shared compile cache
+        drain(1, os.path.join(base, "prime"))
+        arms: dict = {}
+        golden = None
+        parity = True
+        mismatch = None
+        for n in range(1, n_max + 1):
+            wall, res = drain(n, os.path.join(base, f"pool{n}"))
+            arms[f"workers{n}"] = dict(
+                wall_s=round(wall, 2),
+                jobs_per_hour=round(n_jobs / wall * 3600.0, 1),
+            )
+            if golden is None:
+                golden = res
+            else:
+                for j, r in res.items():
+                    g = golden[j]
+                    if any(r.get(k) != g.get(k) for k in PARITY_KEYS):
+                        parity = False
+                        mismatch = dict(
+                            arm=n, job=j,
+                            got={k: r.get(k) for k in PARITY_KEYS},
+                            want={k: g.get(k) for k in PARITY_KEYS},
+                        )
+            print(f"[bench] pool arm {n}w: {wall:.1f}s "
+                  f"({arms[f'workers{n}']['jobs_per_hour']} jobs/h)",
+                  file=sys.stderr)
+    except Exception as e:
+        _emit_failure("pool_run", e, unit="jobs_per_hour")
+        return 1
+
+    first = f"workers{n_max}"
+    ncpu = os.cpu_count() or 1
+    scaling = round(
+        arms[first]["jobs_per_hour"] / arms["workers1"]["jobs_per_hour"],
+        2,
+    )
+    # primary arm first: the pool at full width is the shipped config
+    ordered = {first: arms[first]}
+    ordered.update(
+        (k, v) for k, v in arms.items() if k != first
+    )
+    out = {
+        "schema": "tla-raft-bench-ab/1",
+        "metric": "pool",
+        "arms": ordered,
+        "unit": "jobs_per_hour",
+        "jobs": n_jobs,
+        "scaling_vs_1worker": scaling,
+        "host_cpus": ncpu,
+        "parity": parity,
+        "ok": parity,
+        "device": str(jax.devices()[0]),
+        "config": (
+            f"synthetic queue (seed {seed}, mr_width {mr_width}, "
+            f"chunk {chunk}, {n_jobs} jobs, warm shared compile "
+            f"cache, host_cpus={ncpu})"
+        ),
+    }
+    if mismatch is not None:
+        out["error"] = mismatch
+    print(json.dumps(out))
+    bench_out = os.environ.get("BENCH_OUT")
+    if bench_out:
+        tmp = bench_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        os.replace(tmp, bench_out)
+        _append_trend(out, bench_out)
+    if not keep_root:
+        shutil.rmtree(base, ignore_errors=True)
+    return 0 if parity else 1
+
+
 def main():
     os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
     # mesh benches on a virtual CPU mesh need the device-count XLA flag
@@ -449,6 +600,11 @@ def main():
     # single-sweep throughput bench (docs/SERVICE.md)
     if int(os.environ.get("BENCH_SERVICE", "0")):
         return _bench_service(jax)
+
+    # BENCH_POOL=N: worker-pool drain scaling (jobs/hour at 1..N real
+    # worker processes over the same queue — docs/SERVICE.md)
+    if int(os.environ.get("BENCH_POOL", "0")):
+        return _bench_pool(jax)
 
     # every stage before the engine run is wrapped so an exception
     # anywhere still yields a parseable ok:false line (ADVICE r4 #2:

@@ -1,0 +1,352 @@
+"""Worker pool: lease fencing, membership, admission, chaos campaigns.
+
+ISSUE 19's load-bearing contract: an N-worker pool survives any
+schedule of worker deaths, pauses (SIGSTOP zombies), and torn writes
+with zero lost jobs, zero duplicated terminal commits, and zero
+silently-wrong results.  The fast rows pin the fencing protocol at the
+queue level — a claim that aged out while its holder was paused must
+ABANDON (raise LeaseLost) instead of double-committing — plus the
+membership state machine, the claim()-race exclusivity under real
+threads, the chaos schedule grammar, and service-side counterexample
+traces (result.json carries the same rendered trace ``check.py``
+prints).  The @slow row runs a REAL 3-process campaign through
+``python -m tla_raft_tpu.service chaos``: one worker SIGKILLed
+mid-claim, one SIGSTOPped past the lease TTL and resumed, drained to
+convergence bit-identical to a clean sequential arm.
+"""
+
+import dataclasses
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tla_raft_tpu import resilience
+from tla_raft_tpu.config import RaftConfig
+from tla_raft_tpu.resilience.faults import FaultPlan
+from tla_raft_tpu.service.chaos import parse_schedule
+from tla_raft_tpu.service.pool import WorkerRegistry
+from tla_raft_tpu.service.queue import JobQueue, LeaseLost
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+S2 = RaftConfig(n_servers=2, n_vals=1, max_election=1, max_restart=1)
+
+
+def _mr(cfg, mr, **kw):
+    return dataclasses.replace(cfg, max_restart=mr, **kw)
+
+
+# ---------------------------------------------------------------------------
+# lease fencing (ISSUE 19 bugfix rows)
+# ---------------------------------------------------------------------------
+
+
+def test_lease_carries_fencing_token(tmp_path):
+    q = JobQueue(str(tmp_path), worker="wA")
+    jid = q.submit(S2)
+    assert q.claim(jid)
+    with open(os.path.join(q.job_dir(jid), "lease.json")) as fh:
+        doc = json.load(fh)
+    tok = doc.get("token")
+    assert isinstance(tok, str) and len(tok) == 16
+    assert q.verify_owned(jid) == tok
+    # a re-claim after release mints a FRESH token (tokens are
+    # per-claim, not per-worker — that is what makes them fences)
+    q.release(jid)
+    assert q.claim(jid)
+    with open(os.path.join(q.job_dir(jid), "lease.json")) as fh:
+        assert json.load(fh)["token"] != tok
+
+
+def test_paused_zombie_abandons_instead_of_double_committing(tmp_path):
+    """The ISSUE 19 bug: a worker paused past its TTL wakes up and
+    must NOT complete jobs whose leases were requeued and re-claimed
+    by a peer.  Every terminal transition re-verifies (worker, token)
+    against the on-disk lease and abandons on mismatch."""
+    qA = JobQueue(str(tmp_path), worker="wA", lease_ttl=0.05)
+    jid = qA.submit(S2)
+    assert qA.claim(jid)
+    # wA "pauses" (no heartbeats); the lease ages out; a peer's sweep
+    # requeues the job and the peer claims it under a fresh token
+    time.sleep(0.1)
+    qB = JobQueue(str(tmp_path), worker="wB", lease_ttl=0.05)
+    assert qB.requeue_stale() == [jid]
+    assert qB.claim(jid)
+    # the zombie wakes: heartbeat and complete must both fence
+    with pytest.raises(LeaseLost):
+        qA.heartbeat(jid)
+    with pytest.raises(LeaseLost):
+        qA.complete(jid, dict(ok=True, distinct=1, generated=1,
+                              depth=1, level_sizes=[1], violation=None))
+    assert qA.fenced == 2
+    # the job still belongs to wB, result untouched
+    st = qA.load_state(jid)
+    assert st["status"] == "running" and st["worker"] == "wB"
+    assert qA.load_result(jid) is None
+    # ... and wB's own terminal commit is unaffected
+    qB.complete(jid, dict(ok=True, distinct=1, generated=1, depth=1,
+                          level_sizes=[1], violation=None))
+    assert qB.load_state(jid)["status"] == "done"
+    assert qB.fenced == 0
+
+
+def test_zombie_release_is_quiet_abandon(tmp_path):
+    """release() after lease loss must be a no-op (counted as fenced),
+    NOT clobber the new owner's lease or requeue the job under them."""
+    qA = JobQueue(str(tmp_path), worker="wA", lease_ttl=0.05)
+    jid = qA.submit(S2)
+    assert qA.claim(jid)
+    time.sleep(0.1)
+    qB = JobQueue(str(tmp_path), worker="wB", lease_ttl=0.05)
+    assert qB.requeue_stale() == [jid]
+    assert qB.claim(jid)
+    qA.release(jid, note="drain")  # no exception
+    assert qA.fenced == 1
+    st = qB.load_state(jid)
+    assert st["status"] == "running" and st["worker"] == "wB"
+    assert qB.verify_owned(jid)  # wB's lease survived the zombie
+
+
+def test_thread_claim_race_exactly_one_winner(tmp_path):
+    """N racing threads, M jobs: every job is claimed by EXACTLY one
+    thread (O_EXCL lease create is the mutex), and after a forced
+    staleness sweep the second round again has single winners."""
+    n_threads, jobs = 8, 6
+    queues = [
+        JobQueue(str(tmp_path), worker=f"t{i}", lease_ttl=0.05)
+        for i in range(n_threads)
+    ]
+    jids = [queues[0].submit(_mr(S2, i % 3)) for i in range(jobs)]
+
+    def race(results):
+        barrier = threading.Barrier(n_threads)
+        wins = [[] for _ in range(n_threads)]
+
+        def worker(i):
+            barrier.wait()
+            for jid in jids:
+                if queues[i].claim(jid):
+                    wins[i].append(jid)
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        results.extend(wins)
+
+    round1 = []
+    race(round1)
+    claimed = [j for w in round1 for j in w]
+    assert sorted(claimed) == sorted(jids)  # none lost, none doubled
+    assert all(
+        queues[0].load_state(j)["attempt"] == 1 for j in jids
+    )
+    # age every lease out, requeue, race again: still single winners,
+    # attempts exactly 2
+    time.sleep(0.1)
+    assert sorted(queues[0].requeue_stale()) == sorted(jids)
+    round2 = []
+    race(round2)
+    claimed2 = [j for w in round2 for j in w]
+    assert sorted(claimed2) == sorted(jids)
+    assert all(
+        queues[0].load_state(j)["attempt"] == 2 for j in jids
+    )
+
+
+# ---------------------------------------------------------------------------
+# membership registry
+# ---------------------------------------------------------------------------
+
+
+def test_worker_registry_lifecycle(tmp_path):
+    root = str(tmp_path)
+    reg = WorkerRegistry(root, "w1", ttl=30.0)
+    reg.register()
+    doc = reg.load("w1")
+    assert doc["status"] == "active" and doc["serial"] == 0
+    assert doc["pid"] == os.getpid()
+    reg.beat()
+    reg.beat()
+    assert reg.load("w1")["serial"] == 2
+    assert reg.counts() == dict(active=1, draining=0, dead=0)
+    reg.drain()
+    assert reg.load("w1")["status"] == "draining"
+    reg.deregister(stats=dict(jobs_done=3, fenced=1))
+    doc = reg.load("w1")
+    assert doc["status"] == "dead"
+    assert doc["stats"] == dict(jobs_done=3, fenced=1)
+    assert reg.counts() == dict(active=0, draining=0, dead=1)
+
+
+def test_registry_sweep_marks_dead_pid(tmp_path):
+    """A worker whose process died without deregistering is marked
+    dead by any peer's sweep (pid liveness, the lease policy)."""
+    root = str(tmp_path)
+    reg = WorkerRegistry(root, "w1", ttl=30.0)
+    reg.register()
+    # a peer record whose pid is a real-but-exited process
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    resilience.commit_json(
+        os.path.join(root, "workers", "w2"), "worker.json",
+        dict(schema=1, name="w2", pid=p.pid,
+             host=socket.gethostname(), started=0.0, serial=5,
+             status="active"),
+        kind="worker", manifest=False,
+    )
+    assert reg.sweep() == ["w2"]
+    assert reg.load("w2")["status"] == "dead"
+    assert reg.sweep() == []  # idempotent; self never swept
+    assert reg.counts() == dict(active=1, draining=0, dead=1)
+
+
+# ---------------------------------------------------------------------------
+# chaos schedule grammar
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_schedule_grammar():
+    plans = parse_schedule(
+        "worker2:kill@bucket.level#2;worker3:pause@lease.renew#4, "
+        "worker2:torn@lease.tmp"
+    )
+    assert plans == {
+        "worker2": "bucket.level:kill@2,lease.tmp:torn@1",
+        "worker3": "lease.renew:pause@4",
+    }
+    assert parse_schedule("") == {}
+    with pytest.raises(ValueError):
+        parse_schedule("worker1:explode@bucket.level")  # bad action
+    with pytest.raises(ValueError):
+        parse_schedule("worker1:kill@nowhere")  # bad site
+    with pytest.raises(ValueError):
+        parse_schedule("just-some-words")  # bad shape
+
+
+def test_pause_action_and_pool_sites_in_fault_grammar():
+    # the new pause action and pool sites parse as deterministic
+    # triggers (never FIRED here — pause would SIGSTOP the test run)
+    plan = FaultPlan("lease.renew:pause@3,bucket.level:kill@2,"
+                     "worker.commit:torn@1")
+    assert plan.triggers
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+def test_admission_defers_oversized_tiered_jobs(tmp_path):
+    """A worker with a device-bytes budget leaves tiered jobs whose
+    declared dev_bytes exceed it pending for a bigger peer."""
+    from tla_raft_tpu.service.daemon import Scheduler
+
+    q = JobQueue(str(tmp_path), worker="small")
+    small = q.submit(S2, options=dict(chunk=64, dev_bytes=1e6))
+    big = q.submit(S2, options=dict(chunk=64, dev_bytes=64e9))
+    sched = Scheduler(q, batch=True, min_bucket=2, admit_bytes=1e9)
+    buckets, singles = sched.plan(q.pending())
+    planned = [j for _, jobs in buckets for j, _ in jobs]
+    planned += [j for j, _ in singles]
+    assert small in planned and big not in planned
+    assert sched.stats["deferred"] == 1
+    assert q.load_state(big)["status"] == "submitted"  # stays pending
+
+
+def test_submit_max_queue_rejects_at_depth(tmp_path):
+    """``submit --max-queue N`` is front-door backpressure: once the
+    pending backlog reaches N the submission exits 75 (EX_TEMPFAIL)
+    without creating a job."""
+    from tla_raft_tpu.service.__main__ import main as svc_main
+
+    base = ["submit", "--root", str(tmp_path), "--servers", "2",
+            "--vals", "1", "--max-election", "1", "--max-restart", "1",
+            "--max-queue", "1"]
+    assert svc_main(base) == 0  # depth 0 < 1: admitted
+    q = JobQueue(str(tmp_path), worker="probe")
+    assert len(q.pending()) == 1
+    assert svc_main(base) == 75  # depth 1 >= 1: rejected
+    assert len(q.pending()) == 1  # no job was created
+
+
+# ---------------------------------------------------------------------------
+# service-side counterexample traces (@slow: compiles the batched bucket
+# path at a fresh width, ~45s — the tier-1 budget note in ROADMAP.md.
+# Fast-tier coverage of the same property lives in the CI fleet job and
+# test_three_process_chaos_campaign's traces_ok gate, which compare every
+# fleet result.json trace against the sequential golden arm.)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_batched_violation_result_carries_sequential_trace(tmp_path):
+    """A violating member of a batched bucket gets its counterexample
+    reconstructed service-side: result.json carries the SAME rendered
+    trace a sequential ``check.py`` run prints (check.trace_doc is the
+    single rendering source)."""
+    from tla_raft_tpu.check import run_check, trace_doc
+    from tla_raft_tpu.service.daemon import Scheduler
+
+    viol = _mr(S2, 0, invariants=("~RaftCanCommt",))
+    q = JobQueue(str(tmp_path), worker="w1")
+    j1 = q.submit(viol, options=dict(chunk=64))
+    j2 = q.submit(_mr(viol, 1), options=dict(chunk=64))
+    sched = Scheduler(q, batch=True, min_bucket=2)
+    sched.run_once()
+    assert sched.stats["traces"] == 2
+    res = q.load_result(j1)
+    assert res is not None and res["violation"]
+    golden = run_check(viol, chunk=64)["_res"]
+    assert golden.violation and golden.violation[1]
+    assert res["trace"] == trace_doc(viol, golden.violation[1])
+    # the other member violates too (different restart budget,
+    # different counterexample) and carries its own trace
+    res2 = q.load_result(j2)
+    assert res2["violation"] and res2["trace"]
+
+
+# ---------------------------------------------------------------------------
+# the real thing (@slow): 3 processes, kill + pause, full campaign
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_three_process_chaos_campaign(tmp_path):
+    """worker2 SIGKILLed at its first claim transition, worker3
+    SIGSTOPped at its 3rd lease heartbeat and resumed past the TTL:
+    the pool must drain to convergence bit-identical to the clean
+    sequential arm, with recovery and fencing both exercised."""
+    p = subprocess.run(
+        [
+            sys.executable, "-m", "tla_raft_tpu.service", "chaos",
+            "--base", str(tmp_path), "--workers", "3",
+            "--jobs", "10", "--violations", "1", "--mr-width", "3",
+            "--lease-ttl", "2", "--timeout", "840",
+            "--schedule",
+            "worker2:kill@jobstate.commit#1;"
+            "worker3:pause@lease.renew#3",
+        ],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=900,
+    )
+    assert p.returncode == 0, (p.stdout, p.stderr)
+    report = json.loads(p.stdout.strip().splitlines()[-1])
+    assert report["ok"]
+    assert report["drained"] and report["parity"]
+    assert report["traces_ok"] and report["violations"] == 1
+    assert report["duplicate_commits"] == 0
+    assert report["poisoned"] == 0
+    assert not report["unfired"]
+    assert report["fenced_total"] >= 1  # the zombie abandoned
+    assert report["recovered_total"] >= 1  # the killed worker's jobs
+    assert report["paused_resumed"] == ["worker3"]
+    assert report["worker_exits"]["worker2"] == -9

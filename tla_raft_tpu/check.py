@@ -96,12 +96,27 @@ def format_state(cfg: RaftConfig, st) -> str:
     return "\n".join(lines)
 
 
+def trace_doc(cfg: RaftConfig, trace) -> list[dict]:
+    """Serializable counterexample trace: one ``{action, state}`` dict
+    per step, states rendered exactly as :func:`print_trace` shows
+    them.  The one place trace rendering is defined — the CLI's
+    pretty-printer and the sweep service's ``result.json`` trace block
+    both come from here, so a service-reconstructed trace is
+    byte-equal to what ``check.py`` would print for the same run."""
+    return [
+        dict(
+            action="Initial predicate" if action == "Init" else action,
+            state=format_state(cfg, st),
+        )
+        for action, st in trace
+    ]
+
+
 def print_trace(cfg: RaftConfig, trace, out):
     print("The behavior up to this point is:", file=out)
-    for i, (action, st) in enumerate(trace):
-        label = "Initial predicate" if action == "Init" else action
-        print(f"\nSTATE {i + 1}: <{label}>", file=out)
-        print(format_state(cfg, st), file=out)
+    for i, step in enumerate(trace_doc(cfg, trace)):
+        print(f"\nSTATE {i + 1}: <{step['action']}>", file=out)
+        print(step["state"], file=out)
 
 
 def _report_preempted(e, out, logf) -> int:

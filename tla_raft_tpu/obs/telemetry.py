@@ -823,6 +823,18 @@ def retire(slot, ok, depth, violation=None) -> None:
                  violation=violation)
 
 
+def worker_lifecycle(name: str, status: str, serial: int,
+                     **fields) -> None:
+    """Pool-membership transition (service/pool.py): register / beat /
+    drain / deregister / swept-dead, keyed by the worker's record
+    status so a fleet timeline can be reconstructed from the event
+    stream alone."""
+    hub = CURRENT
+    if hub is not None:
+        hub.emit("worker", name=name, status=status, serial=serial,
+                 **fields)
+
+
 def exchange(level, nbytes, raw, candidates=0, sieved=0) -> None:
     hub = CURRENT
     if hub is not None:

@@ -15,7 +15,13 @@ requested fault when a site's hit counter reaches ``n``:
 * ``flip``  — flip one byte in the middle of the artifact and continue
   (latent media corruption),
 * ``fail``  — raise :class:`FaultError` (a transient error the caller
-  is expected to retry or degrade around).
+  is expected to retry or degrade around),
+* ``pause`` — SIGSTOP the whole process (every thread, including the
+  background lease beater) and keep running once something SIGCONTs
+  it: the zombie-worker case — a GC stall, swap storm or operator ^Z
+  that ages the worker's lease past the TTL while the process still
+  believes it owns its jobs.  The chaos supervisor
+  (``service/chaos.py``) is the something that SIGCONTs it.
 
 Sites follow the artifact kinds of the atomic writer
 (``resilience.manifest.commit_npz``): ``<kind>.tmp`` fires after the
@@ -93,6 +99,16 @@ FAULT_SITES = {
     "result.commit": "service result record: renamed, not manifested",
     "lease.tmp": "service worker lease: tmp written, not renamed",
     "lease.commit": "service worker lease: renamed (unmanifested kind)",
+    "lease.renew": "top of a lease heartbeat, BEFORE the ownership "
+                   "re-check (`pause` here is the canonical zombie: "
+                   "the beater thread wakes after the TTL aged the "
+                   "lease out and must abandon, not double-commit)",
+    "bucket.level": "top of each batched-bucket level (service bucket "
+                    "loop; `kill` here dies mid-bucket with the bstate "
+                    "snapshot behind, `pause` zombifies the worker "
+                    "between level commits)",
+    "worker.tmp": "pool membership record: tmp written, not renamed",
+    "worker.commit": "pool membership record: renamed, not manifested",
     "bstate.tmp": "bucket snapshot: tmp written, not renamed",
     "bstate.commit": "bucket snapshot: renamed, not manifested",
     # elastic-mesh / silent-corruption sites (resilience/elastic.py,
@@ -113,7 +129,7 @@ FAULT_SITES = {
                    "--audit cross-check catches it and rewinds)",
 }
 
-_ACTIONS = ("kill", "torn", "flip", "fail", "lost", "hang")
+_ACTIONS = ("kill", "torn", "flip", "fail", "lost", "hang", "pause")
 
 
 class FaultError(RuntimeError):
@@ -197,6 +213,19 @@ class FaultPlan:
             print(f"{note} — SIGKILL", file=sys.stderr)
             sys.stderr.flush()
             os.kill(os.getpid(), signal.SIGKILL)
+        if action == "pause":
+            # SIGSTOP is uncatchable and stops EVERY thread — unlike a
+            # sleep here, the background lease beater freezes too, so
+            # the lease genuinely ages out.  Execution resumes at the
+            # return below when a supervisor SIGCONTs the process: from
+            # its own point of view the worker never stopped, which is
+            # exactly the confusion lease fencing must survive.
+            print(f"{note} — SIGSTOP (waiting for SIGCONT)",
+                  file=sys.stderr)
+            sys.stderr.flush()
+            os.kill(os.getpid(), signal.SIGSTOP)
+            print(f"{note} — resumed", file=sys.stderr)
+            return
         if action == "fail":
             raise FaultError(f"injected transient failure at {site} (#{n})")
         if action == "lost":

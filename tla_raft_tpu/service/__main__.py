@@ -1,14 +1,17 @@
-"""Sweep-service CLI: submit / status / results / run.
+"""Sweep-service CLI: submit / status / results / run / chaos.
 
     python -m tla_raft_tpu.service submit  --root Q --config Raft.cfg \
         [--servers N] [--vals N] [--max-election N] [--max-restart N] \
         [--max-depth N] [--invariant I]... [--mutate M]... [--chunk N] \
-        [--count N] [--json]
+        [--count N] [--max-queue N] [--json]
     python -m tla_raft_tpu.service status  --root Q [--job ID] [--json]
     python -m tla_raft_tpu.service results --root Q JOB [--json]
     python -m tla_raft_tpu.service run     --root Q [--once] [--poll S] \
         [--max-idle S] [--no-batch] [--min-bucket N] [--lease-ttl S] \
-        [--supervise N]
+        [--supervise N] [--worker NAME] [--admit-configs N] \
+        [--admit-bytes B]
+    python -m tla_raft_tpu.service chaos   --base DIR --workers N \
+        --schedule "worker2:kill@bucket.level;worker3:pause@lease.renew"
 
 ``results`` emits the same ``--json`` summary schema ``check.py``
 produces (one JSON object per line), so sweep tooling parses one
@@ -17,6 +20,17 @@ format whether a config ran through the service or standalone.
 ``check.py --supervise`` uses: crashes and preemptions (exit 75)
 relaunch the daemon, whose first pass requeues the dead worker's
 stale-leased jobs and resumes them from their checkpoint dirs.
+
+``run --worker NAME`` joins the worker pool: the daemon registers a
+health-checked membership record (service/pool.py), heartbeats it every
+pass, and on exit — graceful idle drain or preemption — flips it to
+``draining`` and deregisters with its final scheduler counters, so the
+fleet's fencing/recovery arithmetic survives the worker's death.
+``submit --max-queue N`` is admission control at the front door: when
+the pending backlog is already >= N, the submission is rejected with
+exit 75 (EX_TEMPFAIL — retry later), mirroring the preemption code so
+sweep drivers reuse one backoff path.  ``chaos`` runs a deterministic
+multi-worker fault campaign (service/chaos.py).
 """
 
 from __future__ import annotations
@@ -61,6 +75,15 @@ def _cmd_submit(args) -> int:
     from .queue import JobQueue
 
     q = JobQueue(args.root)
+    if args.max_queue:
+        pending = len(q.pending())
+        if pending >= args.max_queue:
+            print(
+                f"submit rejected: {pending} pending >= --max-queue "
+                f"{args.max_queue} (backpressure; retry later)",
+                file=sys.stderr,
+            )
+            return 75
     cfg = _build_cfg(args)
     options = {}
     if args.chunk is not None:
@@ -215,8 +238,20 @@ def _cmd_run(args, raw_argv) -> int:
         args.root, lease_ttl=args.lease_ttl,
         max_attempts=args.retry_budget,
     )
+    registry = None
+    if args.worker:
+        from .pool import WorkerRegistry
+
+        # membership TTL == lease TTL: a worker whose record goes
+        # stale is presumed dead on the same clock as its job leases
+        registry = WorkerRegistry(
+            args.root, args.worker, ttl=args.lease_ttl,
+        )
+        registry.register()
     sched = Scheduler(
         q, batch=not args.no_batch, min_bucket=args.min_bucket,
+        registry=registry, admit_configs=args.admit_configs,
+        admit_bytes=args.admit_bytes,
     )
     if args.progress:
         # live per-level line for whatever bucket/job is on the device
@@ -224,6 +259,18 @@ def _cmd_run(args, raw_argv) -> int:
 
         pl = ProgressLine(stream=sys.stderr)
         sched.progress = pl.write
+
+    def _leave():
+        # graceful drain: announce, then leave the pool with the final
+        # scheduler counters attached — the chaos/fleet gates audit
+        # fencing and recovery arithmetic from these records after the
+        # worker process is gone
+        if registry is not None:
+            registry.drain()
+            registry.deregister(
+                stats=dict(sched.stats, fenced=q.fenced),
+            )
+
     try:
         if args.once:
             stats = sched.run_once()
@@ -231,9 +278,17 @@ def _cmd_run(args, raw_argv) -> int:
             stats = sched.serve(poll=args.poll, max_idle=args.max_idle)
     except resilience.Preempted as e:
         print(f"[service] preempted: {e}", file=sys.stderr)
+        _leave()
         return 75
+    _leave()
     print(json.dumps(dict(stats, counts=q.counts())))
     return 0
+
+
+def _cmd_chaos(args) -> int:
+    from .chaos import main as chaos_main
+
+    return chaos_main(args)
 
 
 def main(argv=None) -> int:
@@ -269,6 +324,10 @@ def main(argv=None) -> int:
                     help="sequential-path chunk override")
     ps.add_argument("--count", type=int, default=1,
                     help="submit N identical jobs")
+    ps.add_argument("--max-queue", type=int, default=0, metavar="N",
+                    help="admission control: reject the submission "
+                         "with exit 75 (EX_TEMPFAIL, retry later) when "
+                         "the pending backlog is already >= N")
     ps.add_argument("--json", action="store_true")
 
     pt = sub.add_parser("status", help="queue or per-job status")
@@ -310,6 +369,59 @@ def main(argv=None) -> int:
     pd.add_argument("--progress", action="store_true",
                     help="live one-line progress for the in-flight "
                          "bucket/job (states/s, configs alive, ETA)")
+    pd.add_argument("--worker", default=None, metavar="NAME",
+                    help="join the worker pool under NAME: register a "
+                         "health-checked membership record, heartbeat "
+                         "it every pass, deregister (with final "
+                         "counters) on drain or preemption")
+    pd.add_argument("--admit-configs", type=int, default=None,
+                    metavar="N",
+                    help="admission control: claim at most N configs "
+                         "per batched bucket; the tail stays pending "
+                         "for peers (default: env "
+                         "TLA_RAFT_ADMIT_CONFIGS, 0 = unlimited)")
+    pd.add_argument("--admit-bytes", type=float, default=None,
+                    metavar="B",
+                    help="admission control: defer tiered jobs whose "
+                         "declared dev_bytes exceed this worker's "
+                         "device budget (default: env "
+                         "TLA_RAFT_ADMIT_BYTES, 0 = unlimited)")
+
+    pc = sub.add_parser(
+        "chaos",
+        help="deterministic multi-worker fault campaign (kill/pause/"
+             "torn schedules against a synthetic queue, drained to "
+             "convergence and gated bit-identical vs a clean "
+             "sequential arm)",
+    )
+    pc.add_argument("--base", required=True,
+                    help="campaign directory (golden/ and fleet/ queue "
+                         "roots plus the shared compile cache live "
+                         "under it)")
+    pc.add_argument("--workers", type=int, default=3)
+    pc.add_argument("--jobs", type=int, default=60,
+                    help="synthetic queue depth (scripts/queue_synth "
+                         "mix)")
+    pc.add_argument("--violations", type=int, default=2,
+                    help="extra deliberately-violating configs whose "
+                         "counterexample traces must match the "
+                         "sequential arm's")
+    pc.add_argument("--schedule", default="",
+                    help="worker:action@site[#n] items separated by "
+                         "',' or ';' — e.g. 'worker2:kill@bucket."
+                         "level#2;worker3:pause@lease.renew#4'")
+    pc.add_argument("--seed", type=int, default=1)
+    pc.add_argument("--mr-width", type=int, default=5)
+    pc.add_argument("--chunk", type=int, default=64)
+    pc.add_argument("--lease-ttl", type=float, default=2.0)
+    pc.add_argument("--poll", type=float, default=0.3)
+    pc.add_argument("--min-bucket", type=int, default=2)
+    pc.add_argument("--max-idle", type=float, default=None,
+                    help="worker idle-exit window (default: "
+                         "4*lease_ttl + 5, so paused-worker requeues "
+                         "land before peers give up)")
+    pc.add_argument("--timeout", type=float, default=900.0,
+                    help="per-arm drain deadline in seconds")
 
     args = p.parse_args(argv)
     if args.cmd == "submit":
@@ -318,6 +430,10 @@ def main(argv=None) -> int:
         return _cmd_status(args)
     if args.cmd == "results":
         return _cmd_results(args)
+    if args.cmd == "chaos":
+        if args.max_idle is None:
+            args.max_idle = 4.0 * args.lease_ttl + 5.0
+        return _cmd_chaos(args)
     return _cmd_run(args, argv)
 
 

@@ -753,6 +753,10 @@ class BatchedChecker:
 
         # ---- level loop --------------------------------------------------
         while True:
+            # chaos site: a `kill` here dies mid-bucket with the bstate
+            # snapshot behind it; a `pause` zombifies the worker between
+            # level commits (resilience/faults.py, service/chaos.py)
+            resilience.faults.fire("bucket.level")
             # retire members that reached their depth cap (the engine
             # breaks BEFORE expanding at max_depth — same here)
             for c in range(C):

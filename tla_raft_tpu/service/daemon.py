@@ -32,10 +32,10 @@ import threading
 import time
 
 from .. import resilience
-from ..check import run_check, summary_public
+from ..check import run_check, summary_public, trace_doc
 from ..obs import metrics as obs_metrics
 from .bucket import BatchedChecker, bucket_key
-from .queue import JobQueue, doc_to_cfg
+from .queue import JobQueue, LeaseLost, doc_to_cfg
 
 
 class _Beater:
@@ -52,6 +52,13 @@ class _Beater:
     def __init__(self, q: JobQueue, jids):
         self.q = q
         self.jids = list(jids)
+        # jobs whose lease fencing fired mid-run: the claim was lost
+        # (worker paused past the TTL, job requeued) — stop renewing,
+        # and the terminal commit will re-verify and abandon too.
+        # Written by the beater thread, read by the scheduler after
+        # join(); the GIL covers the set ops and __exit__ is the
+        # happens-before edge.  graftsync: waive[GL014]
+        self.lost: set[str] = set()
         self._stop = threading.Event()
         self._t = threading.Thread(
             target=self._run, name="lease-beater", daemon=True
@@ -68,8 +75,12 @@ class _Beater:
 
     def _beat(self):
         for j in self.jids:
+            if j in self.lost:
+                continue
             try:
                 self.q.heartbeat(j)
+            except LeaseLost:
+                self.lost.add(j)  # zombie fenced: abandon renewals
             except OSError:
                 pass  # lease swept mid-write: staleness logic decides
 
@@ -99,17 +110,40 @@ class Scheduler:
         min_bucket: int = 2,
         out=None,
         use_mxu: bool | None = None,
+        registry=None,
+        admit_configs: int | None = None,
+        admit_bytes: float | None = None,
     ):
         self.q = queue
         self.batch = batch
         self.min_bucket = max(1, int(min_bucket))
         self.out = out if out is not None else sys.stderr
         self.use_mxu = use_mxu
+        # pool membership (service/pool.py): registered/beaten/swept
+        # once per pass when the daemon runs as a named pool worker
+        self.registry = registry
+        # admission control: requeue-later instead of OOM-looping.
+        # admit_configs caps how many tenant configs one batched bucket
+        # claims per pass (the rest stay pending for this or another
+        # worker's next pass); admit_bytes defers tiered jobs whose
+        # DECLARED device budget exceeds what this worker can serve
+        # (they stay pending for a bigger worker instead of OOM-looping
+        # this one into the poison quarantine)
+        if admit_configs is None:
+            admit_configs = int(
+                os.environ.get("TLA_RAFT_ADMIT_CONFIGS", "0")
+            )
+        if admit_bytes is None:
+            admit_bytes = float(
+                os.environ.get("TLA_RAFT_ADMIT_BYTES", "0")
+            )
+        self.admit_configs = max(0, int(admit_configs))
+        self.admit_bytes = max(0.0, float(admit_bytes))
         self.stats = dict(
             jobs_done=0, jobs_failed=0, buckets=0, batched_jobs=0,
             sequential_jobs=0, max_bucket=0, dispatches=0, programs=0,
             recovered=0, config_dispatch_weight=0, poisoned=0,
-            tiered_jobs=0,
+            tiered_jobs=0, fenced=0, deferred=0, traces=0,
         )
         # service metrics registry (obs/metrics.py): snapshots commit
         # atomically to <root>/metrics.json after every scheduler pass
@@ -155,6 +189,18 @@ class Scheduler:
                 self.q.fail_unreadable(jid, "unreadable job spec")
                 self.stats["jobs_failed"] += 1
                 continue
+            opt = spec.get("options") or {}
+            if (
+                self.admit_bytes
+                and opt.get("dev_bytes")
+                and float(opt["dev_bytes"]) > self.admit_bytes
+            ):
+                # admission control: this worker cannot serve the job's
+                # declared device budget — leave it pending (requeue-
+                # later for a bigger worker) instead of OOM-looping it
+                # into the poison quarantine
+                self.stats["deferred"] += 1
+                continue
             cfg = doc_to_cfg(spec["config"])
             if self.batch and self._batchable(spec):
                 buckets.setdefault(bucket_key(cfg), []).append((jid, spec))
@@ -179,6 +225,12 @@ class Scheduler:
         return os.path.join(self.q.root, "buckets", h)
 
     def _run_bucket(self, key, jobs) -> None:
+        if self.admit_configs and len(jobs) > self.admit_configs:
+            # bucket-width admission: claim only what fits this
+            # worker's budget; the tail stays pending for the next
+            # pass (or another pool worker's)
+            self.stats["deferred"] += len(jobs) - self.admit_configs
+            jobs = jobs[: self.admit_configs]
         claimed = [(j, s) for j, s in jobs if self.q.claim(j)]
         if not claimed:
             return
@@ -224,8 +276,17 @@ class Scheduler:
                 if self.q.claim(j):
                     self._run_one(j, s)
             return
-        for j, summary in zip(jids, summaries):
-            self.q.complete(j, summary)
+        for (j, s), summary in zip(claimed, summaries):
+            if not summary.get("ok") and summary.get("violation"):
+                summary = self._with_trace(j, s, summary)
+            try:
+                self.q.complete(j, summary)
+            except LeaseLost as e:
+                # fenced at the terminal commit: the job was requeued
+                # while this worker was paused/stalled and may already
+                # run under a new owner — abandon, never double-commit
+                self._say(f"job {j}: abandoned ({e})")
+                continue
             self.stats["jobs_done" if summary["ok"] else "jobs_failed"] += 1
         self.stats["buckets"] += 1
         self.stats["batched_jobs"] += len(claimed)
@@ -252,6 +313,42 @@ class Scheduler:
                 os.remove(p)
             except OSError:
                 pass
+
+    def _with_trace(self, jid: str, spec: dict, summary: dict) -> dict:
+        """Service-side counterexample trace for a violating batched
+        member: the bucket core retires the config with the violation
+        KIND but spools no per-config trace, so the worker re-runs that
+        one config sequentially — it stops at the violation level,
+        writing its delta log into the job's ck dir (the same machinery
+        ``check.py --recover`` replays) — and commits the reconstructed
+        trace into ``result.json``.  Closes ROADMAP item 3's "today:
+        re-run the config through check.py" gap on the service side."""
+        if str(summary.get("violation") or "").startswith("error:"):
+            return summary
+        cfg = doc_to_cfg(spec["config"])
+        opt = spec.get("options") or {}
+        self._say(f"job {jid}: reconstructing counterexample trace")
+        try:
+            full = run_check(
+                cfg,
+                max_depth=spec.get("max_depth"),
+                chunk=int(opt.get("chunk", 1024)),
+                checkpoint_dir=self.q.ck_dir(jid),
+                use_mxu=self.use_mxu,
+            )
+        except Exception as e:  # graftlint: waive[GL003] the trace is best-effort enrichment; the verdict commits without it
+            self._say(
+                f"job {jid}: trace reconstruction failed "
+                f"({type(e).__name__}: {e})"
+            )
+            return summary
+        res = full.get("_res")
+        if res is not None and res.violation and res.violation[1]:
+            self.stats["traces"] += 1
+            return dict(
+                summary, trace=trace_doc(cfg, res.violation[1])
+            )
+        return summary
 
     def _run_one(self, jid: str, spec: dict) -> None:
         cfg = doc_to_cfg(spec["config"])
@@ -289,17 +386,32 @@ class Scheduler:
             raise
         except Exception as e:  # graftlint: waive[GL003] last ladder rung: the job fails with the error recorded, the queue keeps draining
             self._say(f"job {jid} errored: {type(e).__name__}: {e}")
-            self.q.complete(
-                jid,
-                dict(
-                    ok=False, distinct=0, generated=0, depth=0,
-                    level_sizes=[], mxu=None, seconds=None,
-                    violation=f"error: {type(e).__name__}: {e}",
-                ),
-            )
+            try:
+                self.q.complete(
+                    jid,
+                    dict(
+                        ok=False, distinct=0, generated=0, depth=0,
+                        level_sizes=[], mxu=None, seconds=None,
+                        violation=f"error: {type(e).__name__}: {e}",
+                    ),
+                )
+            except LeaseLost as le:
+                self._say(f"job {jid}: abandoned ({le})")
+                return
             self.stats["jobs_failed"] += 1
             return
-        self.q.complete(jid, summary_public(summary))
+        pub = summary_public(summary)
+        res = summary.get("_res")
+        if res is not None and res.violation and res.violation[1]:
+            # sequential jobs carry the live trace already — serialize
+            # it straight into result.json (no re-run needed)
+            pub["trace"] = trace_doc(cfg, res.violation[1])
+            self.stats["traces"] += 1
+        try:
+            self.q.complete(jid, pub)
+        except LeaseLost as e:
+            self._say(f"job {jid}: abandoned ({e})")
+            return
         self.stats["sequential_jobs"] += 1
         if opt.get("dev_bytes"):
             self.stats["tiered_jobs"] += 1
@@ -329,10 +441,19 @@ class Scheduler:
         m.gauge("jobs_per_hour").set(
             round(self.stats["jobs_done"] / hours, 2)
         )
+        # fencing abandons: the queue's counter is authoritative (the
+        # beater thread fences heartbeats there too, not just the
+        # scheduler's terminal commits)
+        self.stats["fenced"] = self.q.fenced
         for k in ("jobs_done", "jobs_failed", "poisoned", "buckets",
                   "batched_jobs", "sequential_jobs", "dispatches",
-                  "programs", "recovered", "tiered_jobs"):
+                  "programs", "recovered", "tiered_jobs", "fenced",
+                  "deferred", "traces"):
             m.counter(k).set(self.stats[k])
+        if self.registry is not None:
+            wc = self.registry.counts()
+            for s in ("active", "draining", "dead"):
+                m.gauge(f"workers_{s}").set(wc.get(s, 0))
         try:
             m.commit(self.q.root)
         except OSError as e:
@@ -347,6 +468,15 @@ class Scheduler:
         One queue scan feeds the whole pass (recover + pending +
         packing) — each helper re-scanning would re-digest every
         state.json several times per poll."""
+        if self.registry is not None:
+            # pool membership liveness: bump this worker's heartbeat
+            # serial and mark peers whose process died without
+            # deregistering (their JOBS come back via requeue_stale
+            # below — the roster sweep is bookkeeping, not recovery)
+            self.registry.beat()
+            swept = self.registry.sweep()
+            if swept:
+                self._say(f"marked dead worker(s): {swept}")
         states = self.q.scan()
         recovered = self.q.requeue_stale(states)
         if recovered:

@@ -1,0 +1,201 @@
+"""Worker-pool membership: health-checked identity records per worker.
+
+The directory queue's O_EXCL leases already make N concurrent workers
+*safe* (queue.py); this module makes the pool *observable and
+operable*: each worker registers an identity record under the queue
+root —
+
+    root/workers/<name>/worker.json
+        {schema, name, pid, host, started, serial, status, stats}
+
+— committed through ``resilience.commit_json`` (atomic tmp -> digest
+-> rename; unmanifested, like leases, because the record is rewritten
+on every scheduler pass).  ``serial`` is the heartbeat serial: it
+increments on every :meth:`WorkerRegistry.beat`, so a reader can
+distinguish "fresh record, stalled worker" from "actively beating"
+without trusting mtime alone (the same reasoning that put fencing
+tokens in the job leases).  ``status`` walks a tiny state machine::
+
+    active --drain--> draining --deregister--> dead
+       |                                         ^
+       +--sweep (pid gone / record stale)--------+
+
+A worker that dies without deregistering is marked ``dead`` by any
+peer's :meth:`sweep` (pid liveness first, record-age TTL as the
+cross-host fallback — exactly the lease staleness policy).  The
+``stats`` block lands at deregistration time and carries the worker's
+final scheduler counters (jobs done/failed, fenced abandons), which is
+how the chaos gate audits "fencing counter == expected abandons"
+across a pool whose members have already exited.
+
+Every record is per-worker-directory, so N workers never contend on
+one file; the registry never blocks the claim path — membership is
+observability and drain coordination, leases stay the source of truth
+for mutual exclusion.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+
+from .. import resilience
+from ..obs import telemetry
+
+WORKERS_DIR = "workers"
+WORKER = "worker.json"
+POOL_SCHEMA = 1
+
+STATUSES = ("active", "draining", "dead")
+
+
+class WorkerRegistry:
+    """One worker's view of the pool membership directory."""
+
+    def __init__(self, root: str, name: str, ttl: float = 30.0):
+        self.root = root
+        self.name = name
+        self.ttl = float(ttl)
+        self.serial = 0
+        self._started = time.time()
+
+    # -- paths ---------------------------------------------------------
+
+    def _dir(self, name: str | None = None) -> str:
+        return os.path.join(self.root, WORKERS_DIR, name or self.name)
+
+    # -- my record -----------------------------------------------------
+
+    def _commit(self, status: str, stats: dict | None = None) -> None:
+        doc = dict(
+            schema=POOL_SCHEMA,
+            name=self.name,
+            pid=os.getpid(),
+            host=socket.gethostname(),
+            started=self._started,
+            serial=self.serial,
+            status=status,
+        )
+        if stats is not None:
+            doc["stats"] = dict(stats)
+        resilience.commit_json(
+            self._dir(), WORKER, doc, kind="worker", manifest=False,
+        )
+        telemetry.worker_lifecycle(self.name, status, self.serial)
+
+    def register(self) -> None:
+        """Join the pool (status ``active``, serial 0)."""
+        self.serial = 0
+        self._commit("active")
+
+    def beat(self) -> None:
+        """Bump the heartbeat serial and recommit (once per scheduler
+        pass — membership liveness, NOT job-lease renewal, which the
+        per-job ``_Beater`` thread owns at ttl/3)."""
+        self.serial += 1
+        self._commit("active")
+
+    def drain(self) -> None:
+        """Announce graceful drain: finishing in-flight work, taking
+        no new claims.  Peers and operators read it from status."""
+        self.serial += 1
+        self._commit("draining")
+
+    def deregister(self, stats: dict | None = None) -> None:
+        """Leave the pool, recording the final scheduler counters."""
+        self.serial += 1
+        self._commit("dead", stats=stats)
+
+    # -- the pool ------------------------------------------------------
+
+    def load(self, name: str) -> dict | None:
+        """Plain JSON read (the lease-reader policy, not
+        load_json_verified: worker dirs hold only this unmanifested
+        high-churn record, and the manifest layer's legacy fallback
+        would misread JSON).  A torn or unreadable record reads as
+        absent — the sweep's age policy then decides."""
+        try:
+            with open(os.path.join(self._dir(name), WORKER),
+                      encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    def record_age(self, name: str) -> float | None:
+        try:
+            path = os.path.join(self._dir(name), WORKER)
+            return time.time() - os.stat(path).st_mtime
+        except OSError:
+            return None
+
+    def list_workers(self) -> dict[str, dict]:
+        """{name: record} for every registered worker (dead included —
+        the record is the pool's history as well as its roster)."""
+        base = os.path.join(self.root, WORKERS_DIR)
+        out: dict[str, dict] = {}
+        try:
+            names = sorted(os.listdir(base))
+        except FileNotFoundError:
+            return out
+        for n in names:
+            doc = self.load(n)
+            if doc is not None:
+                out[n] = doc
+        return out
+
+    def _record_dead(self, doc: dict, age: float | None) -> bool:
+        """Pid liveness is authoritative on the local host: a recorded
+        pid that no longer exists is dead NOW, and one that exists is
+        alive — even mid-bucket, where the worker beats nothing for
+        minutes (unlike job LEASES, which age a stopped-but-alive
+        zombie out so peers can steal its work, membership must not
+        mark a merely-busy worker dead: its very next beat would flip
+        it back and the roster would flap).  The record-age TTL decides
+        only when the pid cannot be checked (cross-host workers)."""
+        pid = doc.get("pid")
+        if (
+            isinstance(pid, int)
+            and doc.get("host") == socket.gethostname()
+        ):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                return True
+            except PermissionError:
+                return False  # exists under another uid
+            return False
+        return age is not None and age > self.ttl
+
+    def sweep(self) -> list[str]:
+        """Mark workers whose process died without deregistering as
+        ``dead`` (keeps the roster honest; their JOBS come back via the
+        queue's stale-lease sweep, not here).  Returns the names newly
+        marked."""
+        out = []
+        for name, doc in self.list_workers().items():
+            if doc.get("status") == "dead" or name == self.name:
+                continue
+            if self._record_dead(doc, self.record_age(name)):
+                resilience.commit_json(
+                    self._dir(name), WORKER,
+                    dict(doc, status="dead",
+                         note="swept: worker process died"),
+                    kind="worker", manifest=False,
+                )
+                telemetry.worker_lifecycle(
+                    name, "dead", int(doc.get("serial", -1)),
+                    swept_by=self.name,
+                )
+                out.append(name)
+        return out
+
+    def counts(self) -> dict[str, int]:
+        c = dict.fromkeys(STATUSES, 0)
+        for doc in self.list_workers().values():
+            s = doc.get("status")
+            if s in c:
+                c[s] += 1
+        return c

@@ -46,6 +46,25 @@ that dies holds its claim only until the lease goes stale
 (``lease_ttl`` seconds without a heartbeat), after which any scheduler
 pass requeues the job — attempt count incremented, checkpoint dir
 intact, so the retry resumes instead of restarting.
+
+**Lease fencing** (the zombie-worker defence): every claim mints a
+fencing token (worker name + a per-claim serial) written into the
+lease record, and every transition that touches a claimed job —
+heartbeat, ``complete``, ``release`` — re-parses the on-disk lease and
+verifies it still carries THIS worker's (name, token) before writing
+anything.  A worker paused past the TTL (SIGSTOP, GC stall, swap
+storm) wakes up believing it owns its jobs; by then the staleness
+sweep has requeued them and another worker's claim minted a new token,
+so the zombie's next heartbeat or terminal commit raises
+:class:`LeaseLost` and the worker ABANDONS the job instead of
+double-committing over the new owner's work.  Mtime alone cannot give
+this guarantee — a fresh mtime only proves *somebody* beat recently.
+The residual verify-then-commit window (ownership lost between the
+re-check and the rename) can at worst duplicate an identical,
+deterministic result commit, never lose or corrupt one — the same
+"duplicate work, never a wrong verdict" contract ``complete`` always
+had.  Abandons are counted in ``JobQueue.fenced`` for the scheduler's
+metrics and the chaos gate.
 """
 
 from __future__ import annotations
@@ -54,6 +73,7 @@ import dataclasses
 import errno
 import json
 import os
+import threading
 import time
 import uuid
 
@@ -95,6 +115,23 @@ def doc_to_cfg(doc: dict) -> RaftConfig:
 FAILED_DIR = "failed"
 
 
+class LeaseLost(RuntimeError):
+    """This worker's lease no longer names it: the job was requeued
+    (TTL aged out while the worker was paused/hung) and possibly
+    reclaimed by another worker.  The only safe move is to abandon the
+    transition — the current lease holder's commit is the one that
+    counts."""
+
+    def __init__(self, job_id: str, holder=None):
+        self.job_id = job_id
+        self.holder = holder  # the lease doc found on disk (or None)
+        who = (
+            f"now held by {holder.get('worker')!r}"
+            if isinstance(holder, dict) else "lease gone"
+        )
+        super().__init__(f"lease lost for job {job_id} ({who})")
+
+
 class JobQueue:
     """The queue API both the client CLI and the daemon go through."""
 
@@ -108,6 +145,15 @@ class JobQueue:
         # poison-job retry budget: a job whose worker dies this many
         # times moves to failed/ instead of requeueing forever
         self.max_attempts = max(1, int(max_attempts))
+        # fencing state: job_id -> the token this instance's claim
+        # minted; `fenced` counts transitions abandoned because the
+        # on-disk lease no longer carried (worker, token).  The lock
+        # covers the counter: heartbeats fence from the daemon's
+        # lease-beater thread while complete/release fence from the
+        # main thread
+        self._tokens: dict[str, str] = {}
+        self._fence_lock = threading.Lock()
+        self.fenced = 0
 
     # -- paths ---------------------------------------------------------
 
@@ -248,22 +294,61 @@ class JobQueue:
             if e.errno == errno.EEXIST:
                 return False
             raise
+        token = uuid.uuid4().hex[:16]
         with os.fdopen(fd, "w") as fh:
             # real JSON (escaped worker name): _lease_dead parses this;
             # a kill mid-write leaves an unparsable lease, which reads
             # as pid-unknown and falls back to the TTL — still safe
             json.dump(
-                dict(worker=self.worker, pid=os.getpid(), beats=0), fh
+                dict(worker=self.worker, pid=os.getpid(), beats=0,
+                     token=token),
+                fh,
             )
             fh.write("\n")
+        self._tokens[job_id] = token
         self._set_state(
             job_id, "running", attempt=int(st.get("attempt", 0)) + 1,
             worker=self.worker, failures=st.get("failures"),
         )
         return True
 
+    def lease_holder(self, job_id: str) -> dict | None:
+        """The lease record on disk, or None (absent/torn)."""
+        try:
+            with open(self._lease_path(job_id), encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    def verify_owned(self, job_id: str, what: str = "transition") -> str:
+        """Fencing check: the on-disk lease must still carry THIS
+        worker's (name, token).  Returns the token; raises
+        :class:`LeaseLost` (and counts the abandon in ``fenced``)
+        when the claim was lost — requeued after a pause past the TTL,
+        swept, or reclaimed by another worker."""
+        tok = self._tokens.get(job_id)
+        doc = self.lease_holder(job_id)
+        if (
+            tok is None
+            or doc is None
+            or doc.get("worker") != self.worker
+            or doc.get("token") != tok
+        ):
+            self._tokens.pop(job_id, None)
+            with self._fence_lock:
+                self.fenced += 1
+            raise LeaseLost(job_id, doc)
+        return tok
+
     def heartbeat(self, job_id: str, beats: int = 0) -> None:
         """Refresh the lease mtime (atomic rewrite, unmanifested).
+
+        Fenced: the rewrite happens only after :meth:`verify_owned`
+        proves the on-disk lease still names this worker's claim — a
+        zombie's heartbeat must not resurrect a lease another worker
+        now owns (the rewrite is a rename, not O_EXCL, so without the
+        check it would clobber the new owner's record).
 
         Retried with exponential backoff + jitter: a transient FS
         error (NFS brownout, ENOSPC blip) on one heartbeat must not
@@ -271,11 +356,15 @@ class JobQueue:
         a second scheduler.  The write is idempotent (same lease doc),
         so the retry is safe; jitter decorrelates a fleet of workers
         all beating against the same brownout."""
+        from ..resilience import faults
+
+        faults.fire("lease.renew")
+        token = self.verify_owned(job_id, "heartbeat")
         resilience.with_retry(
             lambda: resilience.commit_json(
                 self.job_dir(job_id), LEASE,
                 dict(worker=self.worker, pid=os.getpid(),
-                     beats=int(beats)),
+                     beats=int(beats), token=token),
                 kind="lease", manifest=False,
             ),
             f"lease renewal ({job_id})",
@@ -306,7 +395,11 @@ class JobQueue:
     def complete(self, job_id: str, summary: dict) -> None:
         """Commit the result, flip the state, release the lease —
         in that order, so a crash can duplicate work but never lose a
-        committed verdict."""
+        committed verdict.  Fenced: ownership is re-verified BEFORE
+        the result commit, so a zombie worker (paused past the TTL,
+        its job requeued and reclaimed) abandons with
+        :class:`LeaseLost` instead of double-committing."""
+        self.verify_owned(job_id, "complete")
         st = self.load_state(job_id)
         resilience.commit_json(
             self.job_dir(job_id), RESULT,
@@ -318,18 +411,30 @@ class JobQueue:
             attempt=int(st.get("attempt", 0)), worker=self.worker,
             note=summary.get("violation"), failures=st.get("failures"),
         )
+        self._tokens.pop(job_id, None)
         try:
             os.unlink(self._lease_path(job_id))
         except OSError:
             pass
 
     def release(self, job_id: str, note: str | None = None) -> None:
-        """Return a claimed job to the queue (preemption / shutdown)."""
+        """Return a claimed job to the queue (preemption / shutdown).
+
+        Fenced, but ABANDON-quietly rather than raise: a release after
+        the lease was lost means the job is already back in the queue
+        (or running under its new owner) — unlinking the lease or
+        resetting the state here would sabotage the new claim, and the
+        caller is shutting down anyway."""
+        try:
+            self.verify_owned(job_id, "release")
+        except LeaseLost:
+            return
         st = self.load_state(job_id)
         self._set_state(
             job_id, "submitted", attempt=int(st.get("attempt", 0)),
             note=note, failures=st.get("failures"),
         )
+        self._tokens.pop(job_id, None)
         try:
             os.unlink(self._lease_path(job_id))
         except OSError:
