@@ -433,6 +433,198 @@ def _bench_service(jax) -> int:
     return 0 if parity else 1
 
 
+def _bench_cfg():
+    """The bench's config resolution, shared by every lever: RAFT_CFG
+    (default the reference checkout, RaftConfig() constants when the
+    container has none) + the BENCH_SERVERS/VALS/MAX_ELECTION/
+    MAX_RESTART scale-dial overrides."""
+    from tla_raft_tpu.cfgparse import load_raft_config
+
+    cfg_path = os.environ.get("RAFT_CFG", "/root/reference/Raft.cfg")
+    if os.path.exists(cfg_path):
+        cfg = load_raft_config(cfg_path)
+    else:
+        # containers without the reference checkout: RaftConfig()
+        # defaults ARE the Raft.cfg constants (config.py docstring)
+        from tla_raft_tpu.config import RaftConfig
+
+        cfg = RaftConfig()
+        print(
+            f"[bench] {cfg_path} not found; using the built-in "
+            "reference constants", file=sys.stderr,
+        )
+    overrides = {}
+    if os.environ.get("BENCH_SERVERS"):
+        overrides["n_servers"] = int(os.environ["BENCH_SERVERS"])
+    if os.environ.get("BENCH_VALS"):
+        overrides["n_vals"] = int(os.environ["BENCH_VALS"])
+    if os.environ.get("BENCH_MAX_ELECTION"):
+        overrides["max_election"] = int(os.environ["BENCH_MAX_ELECTION"])
+    if os.environ.get("BENCH_MAX_RESTART"):
+        overrides["max_restart"] = int(os.environ["BENCH_MAX_RESTART"])
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg
+
+
+def _bench_tune(jax) -> int:
+    """BENCH_TUNE=1: the autotuned-plan A/B (docs/PERF.md "Autotuned
+    plans").
+
+    Two in-process sweeps of the same config at BENCH_TUNE_DEPTH
+    (default 12): the DEFAULTS arm (``plan=False`` — bit-for-bit the
+    ``TLA_RAFT_PLAN=0`` path) vs the PLAN arm (the regime's knobs from
+    a versioned plan cache).  The plan comes from BENCH_TUNE_PLAN (a
+    plans.json path; default the committed cache,
+    tla_raft_tpu/tune/plans.json); BENCH_TUNE_SEARCH=1 instead runs
+    the coordinate-descent search right here and times it, so the
+    record carries the honest search-cost-vs-steady-win ledger.  Each
+    arm runs once untimed (compile prime) then BENCH_TUNE_REPS timed
+    reps (default 2; best wall wins — single-core hosts time-slice the
+    arms against the OS, so min is the honest point estimate).  Counts
+    must be bit-identical across EVERY run of both arms: a plan may
+    move shapes and schedules, never semantics.
+    """
+    import tempfile
+
+    from tla_raft_tpu.check import run_check
+
+    try:
+        from tla_raft_tpu.tune import plans as tune_plans
+        from tla_raft_tpu.tune import search as tune_search
+
+        cfg = _bench_cfg()
+        max_depth = int(os.environ.get("BENCH_TUNE_DEPTH", "12")) or None
+        reps = max(1, int(os.environ.get("BENCH_TUNE_REPS", "2")))
+        regime = tune_plans.regime_key(cfg, "jax")
+        search_info = None
+        if int(os.environ.get("BENCH_TUNE_SEARCH", "0")):
+            pdir = tempfile.mkdtemp(prefix="bench_tune_")
+            plan_path = os.path.join(pdir, "plans.json")
+            t0 = time.monotonic()
+            sres = tune_search.tune(
+                cfg, backend="jax", path=plan_path, commit=True,
+                max_depth=int(
+                    os.environ.get("BENCH_TUNE_SEARCH_DEPTH", "6")
+                ),
+                top_k=int(os.environ.get("BENCH_TUNE_TOP_K", "2")),
+                out=sys.stderr,
+            )
+            search_info = dict(
+                sres["probe"],
+                wall_s=round(time.monotonic() - t0, 2),
+            )
+        else:
+            plan_path = (
+                os.environ.get("BENCH_TUNE_PLAN")
+                or tune_plans.plan_path()
+            )
+        knobs = tune_plans.resolve(cfg, "jax", path=plan_path)
+        if not knobs:
+            raise RuntimeError(
+                f"no plan for regime {regime} in {plan_path!r} — run "
+                "`python -m tla_raft_tpu.tune` first or set "
+                "BENCH_TUNE_SEARCH=1"
+            )
+    except Exception as e:
+        _emit_failure("bench_setup", e)
+        return 1
+
+    def run_arm(name: str, plan):
+        best = None
+        counts = None
+        for rep in range(reps + 1):  # rep 0 = untimed compile prime
+            t0 = time.monotonic()
+            s = run_check(
+                cfg, backend="jax", max_depth=max_depth, plan=plan,
+                telemetry=True,
+            )
+            wall = time.monotonic() - t0
+            got = (s["distinct"], s["generated"], s["depth"],
+                   tuple(s["level_sizes"]), s["ok"])
+            if counts is None:
+                counts = got
+            elif got != counts:
+                raise RuntimeError(
+                    f"tune arm {name} rep {rep}: counts drifted "
+                    f"within the arm ({got[:3]} vs {counts[:3]})"
+                )
+            if rep == 0:
+                continue
+            tel = s.get("telemetry") or {}
+            rec = {
+                "wall_s": round(wall, 2),
+                "dispatches": tel.get("dispatches"),
+                "levels": tel.get("levels"),
+                "levels_per_dispatch": round(
+                    tel.get("levels", 0)
+                    / max(tel.get("dispatches") or 1, 1), 3,
+                ),
+                "rate": round(s["distinct"] / wall, 1),
+            }
+            if plan and s.get("plan"):
+                rec["knobs"] = s["plan"]
+            if best is None or rec["wall_s"] < best["wall_s"]:
+                best = rec
+        best["counts"] = {
+            "distinct": counts[0], "generated": counts[1],
+            "depth": counts[2], "ok": counts[4],
+        }
+        print(
+            f"[bench] tune arm {name}: best {best['wall_s']}s "
+            f"({best['rate']}/s, {best['levels_per_dispatch']} "
+            f"levels/dispatch)", file=sys.stderr,
+        )
+        return best, counts
+
+    try:
+        arm_p, c_p = run_arm("plan", plan_path)
+        arm_d, c_d = run_arm("defaults", False)
+    except Exception as e:
+        _emit_failure("tune_run", e)
+        return 1
+
+    parity = c_p == c_d and bool(c_p[4])
+    speedup = round(arm_d["wall_s"] / max(arm_p["wall_s"], 1e-9), 3)
+    out = {
+        "schema": "tla-raft-bench-ab/1",
+        "metric": "tune",
+        "arms": {"plan": arm_p, "defaults": arm_d},
+        "unit": "seconds_wall",
+        "speedup_vs_defaults": speedup,
+        "regime": regime,
+        "plan": knobs,
+        "plan_source": plan_path,
+        "reps": reps,
+        "parity": parity,
+        "ok": parity,
+        "distinct": c_p[0],
+        "generated": c_p[1],
+        "depth": c_p[2],
+        "device": str(jax.devices()[0]),
+        "config": (
+            f"{cfg.describe()}, depth<={max_depth}, "
+            f"host_cpus={os.cpu_count()}"
+        ),
+    }
+    if search_info is not None:
+        out["search"] = search_info
+    if not parity:
+        out["error"] = {
+            "plan_counts": list(c_p[:3]),
+            "default_counts": list(c_d[:3]),
+        }
+    print(json.dumps(out))
+    bench_out = os.environ.get("BENCH_OUT")
+    if bench_out:
+        tmp = bench_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        os.replace(tmp, bench_out)
+        _append_trend(out, bench_out)
+    return 0 if parity else 1
+
+
 def _bench_pool(jax) -> int:
     """BENCH_POOL=N: worker-pool drain scaling — jobs/hour at 1..N
     workers over the same synthetic queue (ISSUE 19).
@@ -606,39 +798,20 @@ def main():
     if int(os.environ.get("BENCH_POOL", "0")):
         return _bench_pool(jax)
 
+    # BENCH_TUNE=1: the autotuned-plan A/B (committed plan cache vs
+    # hand-set defaults — docs/PERF.md "Autotuned plans")
+    if int(os.environ.get("BENCH_TUNE", "0")):
+        return _bench_tune(jax)
+
     # every stage before the engine run is wrapped so an exception
     # anywhere still yields a parseable ok:false line (ADVICE r4 #2:
     # the round-3 unparseable-artifact failure mode lived exactly in
     # these unwrapped setup stages)
     try:
-        from tla_raft_tpu.cfgparse import load_raft_config
         from tla_raft_tpu.engine import JaxChecker
         from tla_raft_tpu.oracle import OracleChecker
 
-        cfg_path = os.environ.get("RAFT_CFG", "/root/reference/Raft.cfg")
-        if os.path.exists(cfg_path):
-            cfg = load_raft_config(cfg_path)
-        else:
-            # containers without the reference checkout: RaftConfig()
-            # defaults ARE the Raft.cfg constants (config.py docstring)
-            from tla_raft_tpu.config import RaftConfig
-
-            cfg = RaftConfig()
-            print(
-                f"[bench] {cfg_path} not found; using the built-in "
-                "reference constants", file=sys.stderr,
-            )
-        overrides = {}
-        if os.environ.get("BENCH_SERVERS"):
-            overrides["n_servers"] = int(os.environ["BENCH_SERVERS"])
-        if os.environ.get("BENCH_VALS"):
-            overrides["n_vals"] = int(os.environ["BENCH_VALS"])
-        if os.environ.get("BENCH_MAX_ELECTION"):
-            overrides["max_election"] = int(os.environ["BENCH_MAX_ELECTION"])
-        if os.environ.get("BENCH_MAX_RESTART"):
-            overrides["max_restart"] = int(os.environ["BENCH_MAX_RESTART"])
-        if overrides:
-            cfg = dataclasses.replace(cfg, **overrides)
+        cfg = _bench_cfg()
     except Exception as e:
         _emit_failure("config_setup", e)
         return 1

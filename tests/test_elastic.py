@@ -336,6 +336,19 @@ def test_watchdog_mechanics_inprocess():
         resilience.clear_preempt()
 
 
+def test_device_loss_classifier():
+    """Fast tier of the row below: the classifier itself — only the
+    XLA/PJRT runtime exception types count, never bare text markers."""
+    assert not elastic.is_device_loss(ValueError("boom"))
+    assert not elastic.is_device_loss(RuntimeError("deadline exceeded"))
+    assert not elastic.is_device_loss(
+        RuntimeError("INTERNAL: failed to serialize")
+    )
+
+
+@pytest.mark.slow  # tier-1 budget (PR 20): the classifier row above
+# stays fast; the injected-loss engine run + same-width resume rides
+# with the heavy rows
 def test_device_lost_classified_and_resumable(tmp_path, golden_s2):
     """An injected device loss raises DeviceLost (classified by
     elastic.is_device_loss), leaves the committed log intact, and the

@@ -173,6 +173,9 @@ def test_engine_parity_s2_fixpoint():
     assert a.action_counts == b.action_counts
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): the S2 fixpoint + 3121
+# prefix rows above/below keep hash-vs-sort engine parity fast; the
+# 545-state S3V1 pin rides with the heavy rows
 def test_engine_parity_s3v1_fixpoint():
     a = JaxChecker(S3V1, chunk=256, use_hashstore=False).run()
     b = JaxChecker(S3V1, chunk=256, use_hashstore=True).run()
@@ -238,6 +241,10 @@ def test_mesh_a2a_hash_shards_match_sorted(tmp_path):
     assert a.action_counts == b.action_counts
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): the S2 deep row below
+# (test_mesh_deep_hash_sieve_matches_sorted_sieve) keeps deep-mode
+# hash-sieve parity + slab serialize/resume fast; the 8-dev golden
+# reference prefix rides with the heavy rows
 def test_mesh_deep_golden_prefix_hash_sieve(tmp_path):
     """The deep-sweep acceptance prefix with the hash sieve live: the
     reference constants to depth 8 must land on 1505 distinct / 3044

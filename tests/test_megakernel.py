@@ -184,6 +184,10 @@ def test_invariant_violation_stop_point_parity():
 
 # -- service bucket fusion ------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget (PR 20): single-config fused-vs-
+# staged parity stays fast above, and test_service's fast batched
+# parity row runs the shipped fused bucket path; the staged-bucket
+# cross rides with the heavy rows
 def test_bucket_fused_vs_staged_parity():
     """The service slice of the fusion: a mixed-MaxRestart bucket's
     per-config summaries must be bit-identical between the fused

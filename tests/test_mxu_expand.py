@@ -150,6 +150,9 @@ def test_engine_parity_s3v1_fixpoint_hashstore_cross():
     assert runs[(True, True)].distinct == 545  # the pinned S3V1 fixpoint
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): the S2 fixpoint row above
+# keeps MXU-vs-legacy parity fast, and test_hashstore's fast 3121
+# prefix runs the shipped MXU-on kernel in both arms
 def test_engine_parity_3121_prefix():
     cfg = RaftConfig(n_vals=1, max_election=2, max_restart=1)
     a = JaxChecker(cfg, chunk=256, use_mxu=False).run(max_depth=9)

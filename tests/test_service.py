@@ -171,6 +171,24 @@ def test_config_salts_distinct():
 # ---------------------------------------------------------------------------
 
 
+def test_batched_parity_pair():
+    """Fast tier of the mixed-bucket row below: a 2-member bucket (one
+    full, one depth-capped) against its sequential runs."""
+    cfgs = [_mr(S2, 0), _mr(S2, 1)]
+    depths = [None, 4]
+    got = BatchedChecker(cfgs, max_depths=depths).run()
+    for cfg, d, g in zip(cfgs, depths, got):
+        want = summary_public(run_check(cfg, max_depth=d, chunk=64))
+        assert {k: g[k] for k in PARITY_KEYS} == {
+            k: want[k] for k in PARITY_KEYS
+        }, (cfg.max_restart, d)
+        assert g["violation"] is None
+        assert g["batched"] is True
+
+
+@pytest.mark.slow  # tier-1 budget (PR 20): the 2-member pair above
+# keeps batched-vs-sequential parity fast; the 4-member sweep with a
+# duplicate config rides with the heavy rows
 def test_batched_parity_bucket():
     """A mixed bucket — MaxRestart sweep, a duplicate config, a
     depth-capped member — must reproduce each sequential run exactly."""

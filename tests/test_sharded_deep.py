@@ -126,6 +126,9 @@ def test_deep_parity_reference_depth8(tmp_path):
     assert all(lv["reduction"] >= 2 for lv in deep_lvls), deep_lvls
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): deep-vs-plain counts stay
+# fast via test_deep_multisegment_and_oracle_parity; the per-level
+# raw-byte-ledger cross-check rides with the heavy rows
 def test_deep_matches_uncompressed_exchange(tmp_path):
     """Byte-ledger cross-check: the deep path's 'raw' (uncompressed-
     equivalent) ledger must equal what the plain host-store mesh

@@ -80,6 +80,7 @@ from . import superstep as graft_superstep
 from . import pipeline as graft_pipeline
 from ..analysis import devprof as graft_devprof
 from . import forecast as graft_forecast
+from ..tune import adaptive as graft_adaptive
 from .forecast import MIN_LEVELS as PRESIZE_MIN_LEVELS, pow2ceil as _pow2
 from .invariants import resolve_invariant_kernel
 
@@ -604,9 +605,15 @@ class JaxChecker:
         # restores span-N supersteps under spill (the PR 12 stand-down
         # becomes the sieve-off fallback).  Default ON wherever tiering
         # is; TLA_RAFT_SIEVE=0 / sieve=False reverts to span-1.
-        if sieve is None:
-            sieve = os.environ.get("TLA_RAFT_SIEVE", "1") != "0"
-        self.sieve_enabled = bool(sieve)
+        # the arm decision is governed at RUNTIME when TLA_RAFT_SIEVE
+        # is unset: recent sieve-dirty windows stand the span down
+        # (the replay tax never amortizes — BENCH_SIEVE_AB_r20's ~14%),
+        # a probation of per-level progress re-arms it.  =0 / =1 (or an
+        # explicit argument) still force either mode unconditionally.
+        self.sieve_governor = graft_adaptive.SieveGovernor(
+            graft_adaptive.mode_from_env(sieve)
+        )
+        self.sieve_enabled = self.sieve_governor.mode != "off"
         self._dev_sieve = None      # device u64[M] copy of the filter
         self._dev_sieve_ver = -1    # host filter version it mirrors
         self._dev_sieve_empty = None  # the 1-word all-miss sentinel
@@ -1191,7 +1198,10 @@ class JaxChecker:
                 # whole sub-2x-growth regime: dead output lanes cost
                 # nothing (the materialize scan skips whole-dead
                 # slices), a redo costs a full level
-                est = max(int(fut[0] * 1.25) + 1, 2 * max(n_f, 1))
+                est = max(
+                    int(fut[0] * graft_forecast.cap_margin()) + 1,
+                    2 * max(n_f, 1),
+                )
         if not est:
             est = 4 * max(n_f, 1)
         est = max(est, floor)
@@ -1642,7 +1652,10 @@ class JaxChecker:
         if fut:
             # same margins as the per-level _mega_cap_out, applied to
             # the span max: one static seat for every level in flight
-            est = max(int(max(fut) * 1.25) + 1, 2 * max(n_rows, 1))
+            est = max(
+                int(max(fut) * graft_forecast.cap_margin()) + 1,
+                2 * max(n_rows, 1),
+            )
         else:
             est = 4 * max(n_rows, 1)  # early fan-out bound
         cap_f = max(
@@ -1694,8 +1707,9 @@ class JaxChecker:
         # reserve() grows to FIT (a single doubling can be short of a
         # 4-level span on a >2x-growth run).
         if fut:
+            m = graft_forecast.cap_margin()
             ins_bound = sum(
-                min(int(f * 1.25) + 1, cap_f) for f in fut
+                min(int(f * m) + 1, cap_f) for f in fut
             )
         else:
             ins_bound = 2 * max(n_f, 1)
@@ -2198,7 +2212,9 @@ class JaxChecker:
             return
         nrows = int(fut[0])
         row_b = max(nbytes // max(cap_f, 1), 1)
-        cap_next = self._frontier_cap(int(nrows * 1.25) + 1)
+        cap_next = self._frontier_cap(
+            int(nrows * graft_forecast.cap_margin()) + 1
+        )
         slab_b = 0
         if self.use_hashstore and self.hstore is not None:
             want = hashstore.slab_rows(self.hstore.count + nrows)
@@ -2244,7 +2260,9 @@ class JaxChecker:
         budget = int(float(
             os.environ.get("TLA_RAFT_PRESIZE_BYTES", "4e9")
         ))
-        want_f = max(_pow2(int(peak * 1.25) + 1), self.chunk)
+        want_f = max(
+            _pow2(int(peak * graft_forecast.cap_margin()) + 1), self.chunk
+        )
         if not isinstance(frontier, list):
             row_b = sum(
                 int(np.prod(x.shape[1:])) * x.dtype.itemsize
@@ -3201,7 +3219,8 @@ class JaxChecker:
         """The spill sieve covers every demoted fingerprint: fused
         levels may rely on zero-hit = provably-clean."""
         return (
-            self.sieve_enabled and self._tier_active()
+            self.sieve_enabled and self.sieve_governor.armed
+            and self._tier_active()
             and self.tiered.spill_sieve is not None
         )
 
@@ -4314,6 +4333,9 @@ class JaxChecker:
             # the level top: the previous level is committed, nothing
             # can replay its parents
             self._fseg_retire_consumed()
+            # adaptive sieve: per-level tick drives the stood-down
+            # governor's re-arm probation (tune/adaptive.py)
+            self.sieve_governor.note_level(depth)
             # --- multi-level resident superstep: up to N fused levels
             # in ONE device program + ONE ledgered ring fetch
             # (engine/superstep.py).  A stopped level (abort /
@@ -4449,6 +4471,19 @@ class JaxChecker:
                     if self.watchdog is not None:
                         self.watchdog.disarm(levels=len(sres["recs"]))
                     break
+                # adaptive sieve: feed the window's outcome — whether
+                # it stopped on in-kernel sieve hits — to the governor
+                # (only FLAG_TIER stops count as sieve-dirty; overflow
+                # and ring stops say nothing about revisit density)
+                if self._sieve_ready():
+                    self.sieve_governor.note_window(
+                        sieve_stop=bool(
+                            sres["reason"] == "stop"
+                            and sres["flags"]
+                            & graft_superstep.FLAG_TIER
+                        ),
+                        level=depth,
+                    )
                 if sres["reason"] == "stop" or (
                     sres["reason"] == "ring" and not sres["recs"]
                 ):

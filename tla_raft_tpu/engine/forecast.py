@@ -37,6 +37,25 @@ MIN_LEVELS = 6
 # handful of planned resizes instead of one giant wrong one.
 PRESIZE_HORIZON = 8
 
+# capacity inflation over the raw forecast: the margin every presize
+# floor, the prewarm ladder and the superstep ring share.  Hand-set at
+# 1.25 (forecast error lands inside pow2 rounding at that inflation);
+# TLA_RAFT_CAP_MARGIN overrides, else the installed autotuner plan's
+# ``cap_margin`` knob (tune/plans.py) — one accessor so the three
+# consumers cannot drift on the value.
+DEFAULT_CAP_MARGIN = 1.25
+
+
+def cap_margin(default: float = DEFAULT_CAP_MARGIN) -> float:
+    import os
+
+    env = os.environ.get("TLA_RAFT_CAP_MARGIN")
+    if env:
+        return max(1.0, float(env))
+    from ..tune import active
+
+    return max(1.0, float(active.get("cap_margin", default)))
+
 
 def pow2ceil(n: int) -> int:
     """Smallest power of two >= n (>= 1)."""
@@ -129,7 +148,7 @@ def horizon_forecast(level_sizes, distinct: int, target_depth: int | None):
 
 
 def shape_plan(level_sizes, target_depth: int | None,
-               margin: float = 1.25) -> list[int]:
+               margin: float | None = None) -> list[int]:
     """Margin-inflated per-level row forecast — the AOT prewarm's input.
 
     One entry per forecast level over the horizon: the new-state rows
@@ -141,6 +160,8 @@ def shape_plan(level_sizes, target_depth: int | None,
     keeps the prewarmed ladder and the presize floors from drifting.
     Empty when there is no usable signal yet.
     """
+    if margin is None:
+        margin = cap_margin()
     fut = forecast_new_states(level_sizes, target_depth)[:PRESIZE_HORIZON]
     return [int(f * margin) + 1 for f in fut]
 

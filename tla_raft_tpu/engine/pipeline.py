@@ -69,7 +69,11 @@ def enabled_by_env() -> bool:
 
 def window_from_env(default: int = DEFAULT_WINDOW) -> int:
     v = os.environ.get("TLA_RAFT_PIPELINE_WINDOW")
-    return int(v) if v else default
+    if v:
+        return int(v)
+    from ..tune import active
+
+    return int(active.get("pipeline_window", default))
 
 
 def async_start(tree) -> None:

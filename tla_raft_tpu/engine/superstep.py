@@ -60,6 +60,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import forecast
 from . import megakernel as mk
 
 U64 = jnp.uint64
@@ -130,10 +131,14 @@ REASON_NAMES = {
 
 
 def span_from_env(default: int = DEFAULT_SPAN) -> int:
-    """Levels per dispatch; <= 1 reverts to the per-level megakernel."""
+    """Levels per dispatch; <= 1 reverts to the per-level megakernel.
+    Env wins; an installed autotuner plan's ``superstep_span`` is the
+    fallback (tune/plans.py precedence)."""
     v = os.environ.get("TLA_RAFT_SUPERSTEP")
     if v is None or v == "":
-        return default
+        from ..tune import active
+
+        return max(1, int(active.get("superstep_span", default)))
     return max(1, int(v))
 
 
@@ -391,7 +396,8 @@ def ring_capacity(fut, span: int, cap_f: int, pow2) -> int:
     if span * cap_f <= (1 << 16):
         return pow2(span * cap_f)
     if fut:
-        rungs = [min(int(f * 1.25) + 1, cap_f) for f in fut[:span]]
+        m = forecast.cap_margin()
+        rungs = [min(int(f * m) + 1, cap_f) for f in fut[:span]]
         rungs += [rungs[-1]] * (span - len(rungs))
         est = sum(rungs)
     else:

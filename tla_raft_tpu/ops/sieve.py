@@ -99,7 +99,15 @@ def sieve_words_for(dev_bytes: int) -> int:
     test budgets still filter.  ``TLA_RAFT_SIEVE_BYTES`` overrides the
     byte spend directly."""
     env = os.environ.get("TLA_RAFT_SIEVE_BYTES")
-    nbytes = int(float(env)) if env else max(int(dev_bytes) // 8, 1 << 13)
+    if env:
+        nbytes = int(float(env))
+    else:
+        # plan fallback: the autotuner's sieve_shift knob spends
+        # dev_bytes >> shift (hand-set shift 3 == the 1/8 default)
+        from ..tune import active
+
+        shift = int(active.get("sieve_shift", 3))
+        nbytes = max(int(dev_bytes) >> shift, 1 << 13)
     words = max(nbytes // 8, 1)
     return 1 << max(words.bit_length() - 1, 0)
 

@@ -2943,7 +2943,7 @@ class ShardedChecker:
         # typically this resizes exactly once).  The reactive loops below
         # stay as the backstop for forecast misses.
         from ..engine.forecast import (
-            MIN_LEVELS, horizon_forecast, pow2ceil,
+            MIN_LEVELS, cap_margin, horizon_forecast, pow2ceil,
         )
         self._gather_keep = 0  # all_gather: forecast floor for store trim
         self._cand_hist = []  # per-level max-device candidates / new states
@@ -2958,7 +2958,7 @@ class ShardedChecker:
             # (duplicate fan-out lanes make the hand-modeled ratio
             # undershoot at shallow depths; cand_max tracks the truth)
             r_cd = max(self._cand_hist[-3:]) if self._cand_hist else 4.0 / D
-            want_x = pow2ceil(int(r_cd * peak_new * 1.25) + 1)
+            want_x = pow2ceil(int(r_cd * peak_new * cap_margin()) + 1)
             if self.cap_x_max is not None:
                 want_x = min(want_x, self.cap_x_max)
             # absolute backstops: a forecast gone wrong must degrade to

@@ -1,0 +1,31 @@
+"""Cost-model-driven autotuner with a versioned plan cache.
+
+The repo measures everything (GL013 cost ledgers at live shapes,
+telemetry level timings, the pre-OOM HBM forecast) but until this
+package every performance knob — expand chunk, superstep span, forecast
+cap margins, hashstore PROBE_WINDOW, pipeline window, scheduler bucket
+min size, sieve bytes, compaction fanout, frontier-segment budget —
+was hand-set for one CPU box.  This is the per-silicon hand-tuning a
+fleet cannot afford (ROADMAP item 5); the standard systems move is an
+analytic cost model as the *prior* and short measured probe runs as the
+*ground truth*, with winners cached per hardware/shape regime.
+
+Layout:
+
+* :mod:`.active`  — the process-wide resolved-knob registry the env
+  readers across the tree consult (explicit env/CLI always wins);
+* :mod:`.plans`   — the versioned plan cache (``plans.json`` through
+  ``resilience.commit_json``; schema ``tla-raft-plan/1``), the regime
+  key (one more dimension of the shape_plan ladder), and ``resolve()``;
+* :mod:`.prior`   — GL013-cost-ledger analytic ranking + pre-OOM HBM
+  pruning of candidates before anything is measured;
+* :mod:`.search`  — coordinate-descent probe search: depth-capped runs
+  through the real ``run_check`` path timed off the telemetry hub's
+  ``level_seconds``, winner committed to the plan cache;
+* :mod:`.adaptive`— the sieve arm/stand-down governor driven by the
+  measured ``sieve_stop`` density (ROADMAP item 2 residual).
+
+Counts are bit-identical under ANY plan: every knob here changes
+shapes or schedules, never semantics — the parity tests and the
+``obs trend --check`` count gate enforce it.
+"""

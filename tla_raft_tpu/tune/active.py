@@ -1,0 +1,48 @@
+"""Process-wide resolved-plan knob registry (zero-dependency).
+
+The engines read most knobs through ``*_from_env`` readers scattered
+across ops/store/engine modules (sieve bytes, compaction fanout,
+frontier-segment budget, warm bytes, cap margins).  A resolved plan
+must reach those sites without threading a parameter through every
+constructor and without import cycles (ops/sieve must not import the
+tuner's search machinery), so the resolution lands HERE: ``install()``
+publishes the knob dict, the readers call :func:`get` as their
+*fallback* — an explicit environment variable or CLI flag always beats
+the plan, and with no plan installed every reader keeps its hand-set
+default bit-for-bit.
+
+This mirrors obs/telemetry.py's CURRENT-hub pattern: one module-global,
+one read + one branch on the fast path, no locks (installation happens
+at run setup on the main thread, before any engine loop starts).
+"""
+
+from __future__ import annotations
+
+_ACTIVE: dict | None = None
+
+
+def install(knobs: dict | None) -> None:
+    """Publish a resolved knob dict (None/empty clears)."""
+    global _ACTIVE
+    _ACTIVE = dict(knobs) if knobs else None
+
+
+def clear() -> None:
+    install(None)
+
+
+def installed() -> dict | None:
+    """The currently installed knob dict (a copy), or None."""
+    return dict(_ACTIVE) if _ACTIVE else None
+
+
+def get(name: str, default=None):
+    """The installed plan's value for ``name``, else ``default``.
+
+    Callers pass their hand-set default: with no plan installed (or the
+    plan not covering this knob) behaviour is exactly the pre-tuner
+    repo."""
+    if _ACTIVE is None:
+        return default
+    v = _ACTIVE.get(name)
+    return default if v is None else v

@@ -1,0 +1,122 @@
+"""Analytic candidate ranking: the GL013 cost ledger as the prior.
+
+Probe runs are the ground truth but they cost wall-clock; the prior's
+job is to ORDER candidates (probe the promising ones first) and to
+PRUNE the ones the pre-OOM HBM forecast rejects outright (a span/margin
+pair whose resident ring cannot fit the presize byte budget would
+either OOM or degrade mid-run — no point measuring it).
+
+The model is deliberately coarse — a per-level cost in arbitrary units
+built from the committed ledger's per-program bytes (scaled linearly
+from the audit's tiny reference shapes) plus a fixed per-dispatch
+overhead term, which is exactly the two-axis trade every knob here
+moves: amortization (span, chunk, pipeline window, probe window) vs
+working-set bytes (margins, spans, sieve spend).  Mis-ranking costs one
+extra probe; it can never pick a winner — only measurements commit.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..analysis import cost_audit
+from . import plans
+
+# the audit lowers engine.superstep at cap_f=64 rows (cost_audit); all
+# ledger byte counts scale from this reference row count
+LEDGER_ROWS = 64
+
+# fixed per-dispatch overhead in ledger-byte units: ~38 ms dispatch
+# floor against ~1 GB/s effective small-transfer bandwidth on the
+# measured boxes (docs/PERF.md "chunk cost = 38 ms fixed").  Only the
+# RATIO to the byte term matters for ranking.
+DISPATCH_COST = 38e6
+
+# expected probe-chain slots at the <= 1/2 load factor the hashstore
+# grower enforces (Knuth 6.4); per-round fixed cost approximates one
+# gather launch
+CHAIN_SLOTS = 4
+ROUND_COST = 2e5
+
+
+def _ledger_bytes(name: str, default: float) -> float:
+    led = cost_audit.load_golden() or {}
+    ent = led.get(name) or {}
+    try:
+        v = float(ent.get("bytes", 0) or 0)
+    except (TypeError, ValueError):
+        v = 0.0
+    return v if v > 0 else default
+
+
+def level_cost(knobs: dict, rows: int) -> float:
+    """Modeled cost of one BFS level of ``rows`` new states (arbitrary
+    units, comparable across candidates only)."""
+    d = plans.defaults()
+    k = {**d, **plans.clamp(knobs)}
+    rows = max(1, int(rows))
+    chunk = max(1, int(k["chunk"]))
+    span = max(1, int(k["superstep_span"]))
+    window = max(1, int(k["pipeline_window"]))
+    pw = max(2, int(k["probe_window"]))
+    margin = float(k["cap_margin"])
+
+    # dispatches: one level program per ceil(rows/chunk) chunks, with
+    # the superstep amortizing the per-level program launch across its
+    # span and the pipeline overlapping ~window of the rest
+    chunks = math.ceil(rows / chunk)
+    launches = chunks / span
+    overhead = DISPATCH_COST * launches / min(window, max(1, chunks))
+
+    # streamed bytes: the superstep program's ledgered bytes scaled to
+    # this row count, padded by the margin (capacity padding is real
+    # traffic — dead lanes still move through the fused body)
+    ss_bytes = _ledger_bytes("engine.superstep", 3e6)
+    work = ss_bytes * (rows / LEDGER_ROWS) * (margin / 1.25)
+
+    # membership: probe rounds shrink as the window widens but each
+    # round's gather widens with it (hashstore _probe_rounds)
+    rounds = math.ceil(CHAIN_SLOTS / pw)
+    probe = rounds * (ROUND_COST + pw * rows * 8)
+
+    return overhead + work + probe
+
+
+def hbm_bytes(knobs: dict, rows: int, distinct: int,
+              dev_bytes: int | None = None) -> int:
+    """Forecast device working set under a candidate: the pre-OOM
+    prune.  Mirrors the engine's live gauge classes (bfs _hbm_guard):
+    frontier + margined ring seats for the span, the visited slab at
+    the quantized load factor, and the sieve spend under tiering."""
+    from ..ops import hashstore
+
+    d = plans.defaults()
+    k = {**d, **plans.clamp(knobs)}
+    rows = max(1, int(rows))
+    span = max(1, int(k["superstep_span"]))
+    margin = float(k["cap_margin"])
+    row_b = 128  # packed state record, order-of-magnitude (ops layout)
+    ring = int(rows * margin) * span * 24  # fp + pidx + slot per seat
+    frontier = rows * row_b * 2  # parents + children in flight
+    slab = hashstore.slab_rows(max(int(distinct), rows)) * 8
+    sieve = (int(dev_bytes) >> int(k["sieve_shift"])) if dev_bytes else 0
+    return ring + frontier + slab + sieve
+
+
+def rank(candidates, rows: int, distinct: int, *,
+         dev_bytes: int | None = None,
+         budget: int | None = None):
+    """(kept_sorted_by_modeled_cost, pruned): HBM-rejects drop, the
+    rest order cheapest-modeled-first for probing."""
+    import os
+
+    if budget is None:
+        budget = int(float(os.environ.get("TLA_RAFT_PRESIZE_BYTES", "4e9")))
+    kept, pruned = [], []
+    for knobs in candidates:
+        if hbm_bytes(knobs, rows, distinct, dev_bytes) > budget:
+            pruned.append(knobs)
+        else:
+            kept.append(knobs)
+    kept.sort(key=lambda c: level_cost(c, rows))
+    return kept, pruned

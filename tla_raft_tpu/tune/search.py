@@ -1,0 +1,214 @@
+"""Probe search: short measured runs pick the plan, the cache pins it.
+
+Coordinate descent over the knob registry: start from the hand-set
+defaults, and for one knob at a time probe the candidate values the
+prior ranks best (HBM-pruned first), keeping a move only when the
+measured metric improves past the noise guard.  Probes are depth-capped
+prefixes through the REAL ``run_check`` path — same megakernel, same
+superstep driver, same stores — timed off the telemetry hub's
+``level_seconds`` / ``dispatches_per_level`` deltas so the metric is
+the engine's own steady-state accounting, not an outer wall-clock that
+would swallow import/compile noise.
+
+Every probe asserts count parity against the baseline: a knob that
+changes ``distinct``/``generated``/``depth`` is a semantics bug and the
+search FAILS LOUDLY rather than committing a plan that the
+``obs trend --check`` count gate would (rightly) reject.
+
+The winner commits to the versioned plan cache via
+:func:`plans.commit` (atomic, manifested); each probe emits one
+``tune_probe`` telemetry event so the flight recorder carries the whole
+search trajectory.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..obs import telemetry as obs
+from . import active, plans, prior
+
+# per-coordinate candidate values (the hand-set default is the implicit
+# anchor; order here is just enumeration — the prior decides probe
+# order).  Spans/windows move in octaves: the measured response curves
+# are flat within one (docs/PERF.md), so finer steps would spend probes
+# on noise.
+SEARCH_SPACE = {
+    "chunk": [512, 1024, 2048, 4096],
+    "superstep_span": [2, 4, 8],
+    "pipeline_window": [1, 2, 4],
+    "probe_window": [4, 8, 16],
+    "cap_margin": [1.1, 1.25, 1.5],
+}
+
+# a move must beat the incumbent by this fraction of its metric: CPU
+# wall timings jitter a few percent run-to-run and a sideways move
+# would churn the committed cache every re-tune
+NOISE_GUARD = 0.03
+
+
+def probe(cfg, backend: str, knobs: dict, *, max_depth: int,
+          repeats: int = 1, regime: str = "") -> dict:
+    """One measured candidate: run the depth-capped prefix, return its
+    metrics (best-of-``repeats``).  Installs the candidate's knobs for
+    the duration and restores the process defaults after — callers
+    never see a probe's knobs leak."""
+    from ..check import run_check
+    from ..ops import hashstore
+
+    full = {**plans.defaults(), **plans.clamp(knobs)}
+    hub = obs.current()
+    best = None
+    for _ in range(max(1, repeats)):
+        n0 = len(hub.level_seconds) if hub else 0
+        d0 = len(hub.dispatches_per_level) if hub else 0
+        active.install(full)
+        hashstore.set_probe_window(int(full["probe_window"]))
+        t0 = time.monotonic()
+        try:
+            summary = run_check(
+                cfg, backend=backend, max_depth=max_depth,
+                chunk=int(full["chunk"]),
+                superstep=int(full["superstep_span"]),
+                pipeline_window=int(full["pipeline_window"]),
+                plan=False,  # the candidate IS the plan — don't resolve
+                out=None,
+            )
+        finally:
+            active.clear()
+            hashstore.set_probe_window(None)
+        wall = time.monotonic() - t0
+        if hub is not None:
+            level_s = float(sum(hub.level_seconds[n0:]))
+            dispatches = int(sum(hub.dispatches_per_level[d0:]))
+        else:
+            level_s, dispatches = wall, 0
+        rec = dict(
+            knobs=dict(full),
+            metric=round(level_s if level_s > 0 else wall, 6),
+            wall_s=round(wall, 6),
+            level_s=round(level_s, 6),
+            dispatches=dispatches,
+            distinct=int(summary.get("distinct", 0)),
+            generated=int(summary.get("generated", 0)),
+            depth=int(summary.get("depth", 0)),
+            level_sizes=list(summary.get("level_sizes") or []),
+            ok=bool(summary.get("ok", False)),
+        )
+        if best is None or rec["metric"] < best["metric"]:
+            best = rec
+    obs.emit("tune_probe", regime=regime, knobs=dict(full),
+             metric=best["metric"], wall_s=best["wall_s"],
+             dispatches=best["dispatches"], distinct=best["distinct"],
+             generated=best["generated"], depth=best["depth"],
+             ok=best["ok"])
+    return best
+
+
+def _check_parity(base: dict, cand: dict, knobs: dict) -> None:
+    for key in ("distinct", "generated", "depth"):
+        if cand[key] != base[key]:
+            raise RuntimeError(
+                f"tune probe changed semantics: {key} "
+                f"{base[key]} -> {cand[key]} under {knobs} — "
+                "knobs must change shapes/schedules only"
+            )
+
+
+def search(cfg, backend: str = "jax", *, max_depth: int = 6,
+           repeats: int = 1, space: dict | None = None, top_k: int = 2,
+           dev_bytes: int | None = None, spec: str = "raft",
+           out=None) -> dict:
+    """Coordinate-descent search; returns the result document.
+
+    ``max_depth`` caps each probe (short prefixes: the knobs that win a
+    prefix win the run — the response is per-level); ``top_k`` probes
+    per coordinate after prior ranking; ``dev_bytes`` feeds the HBM
+    prune when tuning a tiered regime."""
+    space = dict(space or SEARCH_SPACE)
+    regime = plans.regime_key(cfg, backend, spec)
+    say = (lambda m: print(m, file=out)) if out else (lambda m: None)
+
+    # one hub for the whole search: probes measure level_seconds deltas
+    # against it (run_check reuses an installed hub, never re-anchors)
+    own_hub = obs.current() is None
+    if own_hub:
+        obs.install(obs.TelemetryHub(
+            run_dir=os.environ.get("TLA_RAFT_TELEMETRY_DIR") or None
+        ))
+    t_search = time.monotonic()
+    try:
+        best_knobs = plans.defaults()
+        say(f"tune {regime}: baseline probe (depth<={max_depth})")
+        base = probe(cfg, backend, best_knobs, max_depth=max_depth,
+                     repeats=repeats, regime=regime)
+        best = base
+        ledger = [base]
+        rows = max(base["level_sizes"] or [1])
+        distinct = base["distinct"]
+        for knob, values in space.items():
+            cands = [
+                {**best_knobs, knob: v}
+                for v in values if v != best_knobs.get(knob)
+            ]
+            ranked, pruned = prior.rank(
+                cands, rows, distinct, dev_bytes=dev_bytes
+            )
+            for c in pruned:
+                say(f"tune {regime}: {knob}={c[knob]} pruned (HBM "
+                    "forecast over budget)")
+            for c in ranked[:top_k]:
+                rec = probe(cfg, backend, c, max_depth=max_depth,
+                            repeats=repeats, regime=regime)
+                _check_parity(base, rec, c)
+                ledger.append(rec)
+                say(f"tune {regime}: {knob}={c[knob]} -> "
+                    f"{rec['metric']:.4f}s (best {best['metric']:.4f}s)")
+                if rec["metric"] < best["metric"] * (1 - NOISE_GUARD):
+                    best, best_knobs = rec, {**plans.defaults(),
+                                             **plans.clamp(c)}
+        search_s = time.monotonic() - t_search
+        say(
+            f"tune {regime}: winner {best_knobs} "
+            f"metric {best['metric']:.4f}s vs baseline "
+            f"{base['metric']:.4f}s ({len(ledger)} probes, "
+            f"{search_s:.1f}s search)"
+        )
+        return dict(
+            regime=regime,
+            knobs=best_knobs,
+            probe=dict(
+                baseline=base["metric"],
+                winner=best["metric"],
+                probes=len(ledger),
+                search_s=round(search_s, 3),
+                max_depth=max_depth,
+                distinct=base["distinct"],
+                generated=base["generated"],
+                depth=base["depth"],
+            ),
+            ledger=ledger,
+        )
+    finally:
+        if own_hub:
+            hub = obs.current()
+            obs.install(None)
+            if hub is not None:
+                hub.close()
+
+
+def tune(cfg, backend: str = "jax", *, path: str | None = None,
+         commit: bool = True, **kw) -> dict:
+    """Search + commit: the one-call entry ``--tune`` and the CI smoke
+    use.  ``path`` defaults to the active plan file (TLA_RAFT_PLAN);
+    with plans disabled the winner still returns but nothing commits."""
+    res = search(cfg, backend, **kw)
+    if commit:
+        if path is None:
+            path = plans.plan_path()
+        if path is not None:
+            plans.commit(path, res["regime"], res["knobs"],
+                         probe=res["probe"])
+            res["committed"] = path
+    return res
